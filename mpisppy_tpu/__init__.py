@@ -1,0 +1,27 @@
+###############################################################################
+# mpisppy-tpu: TPU-native stochastic programming (scenario decomposition)
+#
+# A from-scratch JAX/XLA re-design of the capabilities of mpi-sppy
+# (Pyomo/mpi-sppy).  Scenario subproblems are batched into vmapped
+# first-order LP/QP solves over an HBM-resident scenario tensor sharded
+# across a TPU mesh; nonanticipativity reductions use XLA collectives
+# instead of MPI allreduce.
+#
+# Reference parity notes cite files in the reference repo as
+# ``ref:<path>:<lines>`` (e.g. ref:mpisppy/phbase.py:32-112).
+###############################################################################
+import time as _time
+
+__version__ = "0.1.0"
+
+_T0 = _time.time()
+
+
+def global_toc(msg: str, cond: bool = True) -> None:
+    """Timestamped progress logging (ref:mpisppy/__init__.py:16-22).
+
+    The reference gates on ``rank == 0``; here there is a single
+    controller process, so ``cond`` is caller-supplied (default True).
+    """
+    if cond:
+        print(f"[{_time.time() - _T0:9.2f}] {msg}", flush=True)

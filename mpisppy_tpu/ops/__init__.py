@@ -1,0 +1,2 @@
+from mpisppy_tpu.ops.boxqp import BoxQP, kkt_residuals, objective  # noqa: F401
+from mpisppy_tpu.ops.pdhg import PDHGOptions, PDHGState, solve, solve_batch  # noqa: F401

@@ -1,0 +1,202 @@
+###############################################################################
+# BoxQP: the canonical subproblem form of the TPU framework.
+#
+# Every scenario subproblem the framework solves (PH prox subproblems,
+# Lagrangian bound solves, xhat recourse evaluations, extensive forms) is
+# an instance of
+#
+#     min   c'x + 1/2 x' diag(q) x
+#     s.t.  bl <= A x <= bu          (two-sided row constraints)
+#           l  <=   x <= u           (box)
+#
+# This replaces the role Pyomo ConcreteModel + Gurobi play in the
+# reference (ref:mpisppy/spopt.py:99-247 dispatches each scenario model
+# to a CPU solver).  Here a scenario is a pytree of dense arrays so that
+# thousands of scenarios batch into one XLA program: vmap over the
+# leading axis maps subproblems onto the MXU, and `q` being diagonal
+# makes the PH prox term (rho/2)||x - xbar||^2 an O(n) exact prox.
+#
+# Equality rows are bl == bu; one-sided rows use +/-inf.  Integrality is
+# carried as a mask (`integer`) but relaxed at solve time — the
+# reference leans on MIP solvers for exactness (ref:mpisppy/spopt.py:884);
+# we use LP relaxation + fix/round heuristics (see algos/xhat*).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["c", "q", "A", "bl", "bu", "l", "u"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class BoxQP:
+    """One (or, with a leading batch axis, many) box-constrained QP(s).
+
+    Shapes (unbatched): c,q,l,u: (n,); A: (m,n); bl,bu: (m,).
+    A batch of S scenarios adds a leading S axis to every field, or — for
+    scenario families whose constraint matrix is deterministic (e.g. sslp,
+    where only the RHS is random) — `A` may stay (m,n) and broadcast.
+    """
+
+    c: Array
+    q: Array
+    A: Array
+    bl: Array
+    bu: Array
+    l: Array  # noqa: E741
+    u: Array
+
+    @property
+    def n(self) -> int:
+        return self.c.shape[-1]
+
+    @property
+    def m(self) -> int:
+        return self.A.shape[-2]
+
+    @property
+    def batched(self) -> bool:
+        return self.c.ndim == 2
+
+    @property
+    def nbatch(self) -> int:
+        return self.c.shape[0] if self.batched else 1
+
+    def matvec(self, x: Array) -> Array:
+        """A @ x, batch-aware (A may be shared across the batch)."""
+        if self.A.ndim == x.ndim + 1:
+            return jnp.einsum("...mn,...n->...m", self.A, x)
+        # shared A with batched x
+        return jnp.einsum("mn,...n->...m", self.A, x)
+
+    def rmatvec(self, y: Array) -> Array:
+        """A.T @ y, batch-aware."""
+        if self.A.ndim == y.ndim + 1:
+            return jnp.einsum("...mn,...m->...n", self.A, y)
+        return jnp.einsum("mn,...m->...n", self.A, y)
+
+
+def make_boxqp(c, A, bl, bu, l, u, q=None, dtype=jnp.float32) -> BoxQP:  # noqa: E741
+    """Build a BoxQP from numpy-ish inputs, defaulting q to zeros."""
+    c = jnp.asarray(c, dtype)
+    if q is None:
+        q = jnp.zeros_like(c)
+    return BoxQP(
+        c=c,
+        q=jnp.asarray(q, dtype),
+        A=jnp.asarray(A, dtype),
+        bl=jnp.asarray(bl, dtype),
+        bu=jnp.asarray(bu, dtype),
+        l=jnp.asarray(l, dtype),
+        u=jnp.asarray(u, dtype),
+    )
+
+
+def objective(p: BoxQP, x: Array) -> Array:
+    """c'x + 1/2 x'diag(q)x (sums over the trailing axis only)."""
+    return jnp.sum(p.c * x + 0.5 * p.q * x * x, axis=-1)
+
+
+def dual_objective(p: BoxQP, x: Array, y: Array) -> Array:
+    """Fenchel dual value at (y, reduced costs), using x for the Q term.
+
+    For min c'x + 1/2 x'Qx + I_[l,u](x) + I_[bl,bu](Ax) the dual is
+        max  -1/2 x'Qx - g*(y) - sup_{l<=z<=u} (-(c+Qx+A'y))'z
+    Contributions from infinite bounds against adverse reduced-cost signs
+    are excluded here; they show up in the dual residual instead
+    (PDLP-style accounting).
+    """
+    rc = p.c + p.q * x + p.rmatvec(y)
+    # -g*(y): y>0 pairs with bu, y<0 with bl (our sign convention:
+    # y in dsubgradient of I_[bl,bu] at Ax).
+    ycontrib = jnp.where(y > 0.0, p.bu * y, p.bl * y)
+    ycontrib = jnp.where(jnp.isfinite(ycontrib), ycontrib, 0.0)
+    # reduced-cost bound contribution: rc>0 pairs with l, rc<0 with u.
+    rccontrib = jnp.where(rc > 0.0, p.l * rc, p.u * rc)
+    rccontrib = jnp.where(jnp.isfinite(rccontrib), rccontrib, 0.0)
+    quad = 0.5 * jnp.sum(p.q * x * x, axis=-1)
+    return -quad - jnp.sum(ycontrib, axis=-1) + jnp.sum(rccontrib, axis=-1)
+
+
+def primal_residual(p: BoxQP, x: Array) -> Array:
+    """Per-row distance of Ax from [bl, bu] (0 when feasible)."""
+    ax = p.matvec(x)
+    return jnp.maximum(ax - p.bu, 0.0) + jnp.maximum(p.bl - ax, 0.0)
+
+
+def dual_residual(p: BoxQP, x: Array, y: Array) -> Array:
+    """Per-column dual infeasibility.
+
+    rc_i > 0 is certified by a finite lower bound, rc_i < 0 by a finite
+    upper bound; anything else is residual (PDLP convention).
+    """
+    rc = p.c + p.q * x + p.rmatvec(y)
+    pos_ok = jnp.isfinite(p.l)
+    neg_ok = jnp.isfinite(p.u)
+    res_pos = jnp.where(pos_ok, 0.0, jnp.maximum(rc, 0.0))
+    res_neg = jnp.where(neg_ok, 0.0, jnp.maximum(-rc, 0.0))
+    return res_pos + res_neg
+
+
+def kkt_residuals(p: BoxQP, x: Array, y: Array):
+    """(rel_primal, rel_dual, rel_gap) — relative inf-norm KKT residuals."""
+    rp = jnp.max(jnp.abs(primal_residual(p, x)), axis=-1)
+    rd = jnp.max(jnp.abs(dual_residual(p, x, y)), axis=-1)
+    b_scale = jnp.where(jnp.isfinite(p.bl), jnp.abs(p.bl), 0.0)
+    b_scale = jnp.maximum(b_scale, jnp.where(jnp.isfinite(p.bu), jnp.abs(p.bu), 0.0))
+    c_scale = jnp.max(jnp.abs(p.c), axis=-1, initial=0.0)
+    pobj = objective(p, x)
+    dobj = dual_objective(p, x, y)
+    rel_p = rp / (1.0 + jnp.max(b_scale, axis=-1, initial=0.0))
+    rel_d = rd / (1.0 + c_scale)
+    rel_g = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+    return rel_p, rel_d, rel_g
+
+
+# --------------------------------------------------------------------------
+# Ruiz equilibration.  The reference delegates conditioning to Gurobi;
+# first-order methods need it done explicitly (cf. PDLP).  Performed in
+# numpy at problem-build time (not traced).
+# --------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Scaling:
+    """x_orig = d_col * x_scaled ; y_orig = d_row * y_scaled."""
+
+    d_row: np.ndarray
+    d_col: np.ndarray
+
+
+def ruiz_scale(p: BoxQP, iters: int = 10) -> tuple[BoxQP, Scaling]:
+    """Iterative row/col inf-norm equilibration of A, applied to the
+    whole problem.  Batched A gets per-batch scalings."""
+    A = np.asarray(p.A, np.float64)
+    dr = np.ones(A.shape[:-1], A.dtype)
+    dc = np.ones(A.shape[:-2] + (A.shape[-1],), A.dtype)
+    for _ in range(iters):
+        rmax = np.maximum(np.max(np.abs(A), axis=-1), 1e-12)
+        A = A / np.sqrt(rmax)[..., None]
+        dr = dr / np.sqrt(rmax)
+        cmax = np.maximum(np.max(np.abs(A), axis=-2), 1e-12)
+        A = A / np.sqrt(cmax)[..., None, :]
+        dc = dc / np.sqrt(cmax)
+    dt = p.c.dtype
+    scaled = BoxQP(
+        c=jnp.asarray(np.asarray(p.c, np.float64) * dc, dt),
+        q=jnp.asarray(np.asarray(p.q, np.float64) * dc * dc, dt),
+        A=jnp.asarray(A, dt),
+        bl=jnp.asarray(np.asarray(p.bl, np.float64) * dr, dt),
+        bu=jnp.asarray(np.asarray(p.bu, np.float64) * dr, dt),
+        l=jnp.asarray(np.asarray(p.l, np.float64) / dc, dt),
+        u=jnp.asarray(np.asarray(p.u, np.float64) / dc, dt),
+    )
+    return scaled, Scaling(d_row=dr, d_col=dc)

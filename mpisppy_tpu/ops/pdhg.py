@@ -1,0 +1,226 @@
+###############################################################################
+# Restarted PDHG (PDLP-style) for batched BoxQPs.
+#
+# This kernel plays the role Gurobi/CPLEX play in the reference
+# (ref:mpisppy/spopt.py:99-247, spopt.py:876-960): it is THE subproblem
+# solver.  Design points, all TPU-driven:
+#
+#   * One batched tensor program: every field carries an optional leading
+#     scenario axis; matvecs become (S,m,n)x(S,n) einsums that XLA tiles
+#     onto the MXU.  A thousand scenario LPs are one program, not a
+#     thousand solver calls (contrast ref:mpisppy/spopt.py:250-341, a
+#     sequential Python loop over per-scenario solver plugins).
+#   * No data-dependent Python control flow: the solve is a
+#     lax.while_loop over restart windows, each window a lax.fori_loop of
+#     PDHG iterations.  Per-problem termination is a `done` mask, not an
+#     early exit, so the batch stays rectangular for XLA.
+#   * Warm starts are first-class: PH re-solves the same constraint data
+#     with updated linear/diagonal-quadratic terms every iteration, so
+#     PDHGState (iterates + step-size machinery) is carried across calls.
+#
+# Algorithm: Chambolle-Pock primal-dual hybrid gradient with
+#   - exact prox of c'x + 1/2 q x^2 over [l,u] (diagonal q),
+#   - dual prox of the [bl,bu] row-indicator via Moreau,
+#   - restart-to-average every `restart_period` iterations, keeping the
+#     better of {current, window average} by relative KKT score,
+#   - adaptive primal weight omega rebalancing primal/dual step sizes
+#     (tau = omega/||A||, sigma = 1/(omega ||A||)),
+# following the PDLP recipe (Applegate et al.; see also MPAX in
+# PAPERS.md) re-implemented from the math, not from any codebase.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.ops.boxqp import BoxQP, kkt_residuals
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PDHGOptions:
+    """Static solver options (hashable: safe as a jit static arg)."""
+
+    tol: float = 1e-6
+    max_iters: int = 20_000
+    restart_period: int = 40
+    omega0: float = 1.0
+    power_iters: int = 30
+    omega_min: float = 1e-4
+    omega_max: float = 1e4
+    step_margin: float = 0.99  # tau*sigma*||A||^2 = step_margin^2 < 1
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "x", "y", "x_sum", "y_sum", "x_anchor", "y_anchor",
+        "omega", "Lnorm", "k", "score", "done",
+    ],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class PDHGState:
+    x: Array        # (..., n) primal iterate
+    y: Array        # (..., m) dual iterate
+    x_sum: Array    # running window sums for restart-to-average
+    y_sum: Array
+    x_anchor: Array  # iterate at last restart (for omega adaptation)
+    y_anchor: Array
+    omega: Array    # (...,) primal weight
+    Lnorm: Array    # (...,) ||A||_2 estimate
+    k: Array        # () global iteration counter
+    score: Array    # (...,) last max relative KKT residual
+    done: Array     # (...,) bool
+
+
+def _bshape(p: BoxQP):
+    """Batch shape of a problem: () or (S,)."""
+    return p.c.shape[:-1]
+
+
+def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
+    """Power iteration for ||A||_2, batch-aware."""
+    n = p.c.shape[-1]
+    v = jnp.ones_like(p.c) / jnp.sqrt(jnp.asarray(n, p.c.dtype))
+
+    def body(_, carry):
+        v, _ = carry
+        w = p.rmatvec(p.matvec(v))
+        nrm = jnp.linalg.norm(w, axis=-1, keepdims=True)
+        nrm = jnp.maximum(nrm, 1e-30)
+        return w / nrm, nrm[..., 0]
+
+    _, lam = jax.lax.fori_loop(0, iters, body, (v, jnp.ones(_bshape(p), p.c.dtype)))
+    return jnp.maximum(jnp.sqrt(lam), 1e-12)
+
+
+def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
+               x0: Array | None = None, y0: Array | None = None) -> PDHGState:
+    bs = _bshape(p)
+    dt = p.c.dtype
+    if x0 is None:
+        x0 = jnp.clip(jnp.zeros_like(p.c), p.l, p.u)
+    if y0 is None:
+        y0 = jnp.zeros(bs + (p.m,), dt)
+    L = estimate_norm(p, opts.power_iters)
+    return PDHGState(
+        x=x0, y=y0,
+        x_sum=jnp.zeros_like(x0), y_sum=jnp.zeros_like(y0),
+        x_anchor=x0, y_anchor=y0,
+        omega=jnp.full(bs, opts.omega0, dt),
+        Lnorm=L.astype(dt),
+        k=jnp.zeros((), jnp.int32),
+        score=jnp.full(bs, jnp.inf, dt),
+        done=jnp.zeros(bs, bool),
+    )
+
+
+def _pdhg_iter(p: BoxQP, st: PDHGState, tau: Array, sigma: Array) -> PDHGState:
+    """One PDHG step; frozen for problems already `done`."""
+    t = tau[..., None]
+    s = sigma[..., None]
+    v = st.x - t * p.rmatvec(st.y)
+    x1 = jnp.clip((v - t * p.c) / (1.0 + t * p.q), p.l, p.u)
+    w = st.y + s * p.matvec(2.0 * x1 - st.x)
+    y1 = w - s * jnp.clip(w / s, p.bl, p.bu)
+    keep = st.done[..., None]
+    x1 = jnp.where(keep, st.x, x1)
+    y1 = jnp.where(keep, st.y, y1)
+    return dataclasses.replace(
+        st, x=x1, y=y1, x_sum=st.x_sum + x1, y_sum=st.y_sum + y1,
+    )
+
+
+def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
+    """Restart-to-average + omega adaptation + convergence check."""
+    navg = jnp.asarray(opts.restart_period, st.x.dtype)
+    xa, ya = st.x_sum / navg, st.y_sum / navg
+
+    rp_c, rd_c, rg_c = kkt_residuals(p, st.x, st.y)
+    rp_a, rd_a, rg_a = kkt_residuals(p, xa, ya)
+    score_c = jnp.maximum(jnp.maximum(rp_c, rd_c), rg_c)
+    score_a = jnp.maximum(jnp.maximum(rp_a, rd_a), rg_a)
+
+    take_avg = (score_a < score_c)[..., None]
+    xr = jnp.where(take_avg, xa, st.x)
+    yr = jnp.where(take_avg, ya, st.y)
+    score = jnp.minimum(score_a, score_c)
+
+    # Primal-weight adaptation (theta = 0.5 log-space smoothing).
+    dx = jnp.linalg.norm(xr - st.x_anchor, axis=-1)
+    dy = jnp.linalg.norm(yr - st.y_anchor, axis=-1)
+    valid = (dx > 1e-12) & (dy > 1e-12)
+    omega_new = jnp.exp(0.5 * jnp.log(jnp.where(valid, dy / jnp.maximum(dx, 1e-30), 1.0))
+                        + 0.5 * jnp.log(st.omega))
+    omega = jnp.clip(jnp.where(valid, omega_new, st.omega),
+                     opts.omega_min, opts.omega_max)
+
+    keep = st.done
+    return dataclasses.replace(
+        st,
+        x=jnp.where(keep[..., None], st.x, xr),
+        y=jnp.where(keep[..., None], st.y, yr),
+        x_sum=jnp.zeros_like(st.x_sum),
+        y_sum=jnp.zeros_like(st.y_sum),
+        x_anchor=jnp.where(keep[..., None], st.x_anchor, xr),
+        y_anchor=jnp.where(keep[..., None], st.y_anchor, yr),
+        omega=jnp.where(keep, st.omega, omega),
+        score=jnp.where(keep, st.score, score),
+        done=keep | (score <= opts.tol),
+    )
+
+
+def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
+    tau = opts.step_margin * st.omega / st.Lnorm
+    sigma = opts.step_margin / (st.omega * st.Lnorm)
+    st = jax.lax.fori_loop(
+        0, opts.restart_period, lambda _, s: _pdhg_iter(p, s, tau, sigma), st
+    )
+    st = _restart(p, st, opts)
+    return dataclasses.replace(st, k=st.k + opts.restart_period)
+
+
+def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
+          state: PDHGState | None = None) -> PDHGState:
+    """Solve to tolerance (batch-aware).  Jit-friendly:
+    ``jax.jit(solve, static_argnames='opts')``."""
+    if state is None:
+        st = init_state(p, opts)
+    else:
+        # Reuse iterates + step machinery; reset bookkeeping.
+        st = dataclasses.replace(
+            state,
+            x_sum=jnp.zeros_like(state.x), y_sum=jnp.zeros_like(state.y),
+            x_anchor=state.x, y_anchor=state.y,
+            k=jnp.zeros((), jnp.int32),
+            score=jnp.full(state.omega.shape, jnp.inf, state.x.dtype),
+            done=jnp.zeros(state.omega.shape, bool),
+        )
+
+    def cond(s):
+        return (s.k < opts.max_iters) & ~jnp.all(s.done)
+
+    return jax.lax.while_loop(cond, lambda s: _window(p, s, opts), st)
+
+
+def solve_fixed(p: BoxQP, n_windows: int, opts: PDHGOptions,
+                state: PDHGState) -> PDHGState:
+    """Fixed budget: n_windows restart windows, no early exit.  This is
+    the inner solver for PH hot loops (inexact subproblem solves with
+    warm starts), where a static iteration count keeps the whole PH step
+    a single compiled program."""
+    st = dataclasses.replace(
+        state,
+        x_sum=jnp.zeros_like(state.x), y_sum=jnp.zeros_like(state.y),
+        x_anchor=state.x, y_anchor=state.y,
+        done=jnp.zeros(state.omega.shape, bool),
+    )
+    return jax.lax.fori_loop(0, n_windows, lambda _, s: _window(p, s, opts), st)
+
+
+solve_batch = solve  # batching is implicit via leading axes

@@ -1,0 +1,116 @@
+# Unit tests for the batched PDHG kernel against scipy.linprog oracles.
+# Mirrors the role of solver-adaptive smoke tests in the reference
+# (ref:mpisppy/tests/utils.py:14-34) — but our "solver" is in-repo, so we
+# can test tight tolerances against an independent implementation.
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.ops import boxqp, pdhg
+
+
+def random_lp(rng, n=20, m=12, two_sided=False):
+    """A feasible, bounded random LP in BoxQP form + its scipy solution."""
+    A = rng.normal(size=(m, n))
+    x0 = rng.uniform(0.5, 2.0, size=n)
+    slack = rng.uniform(0.1, 1.0, size=m)
+    bu = A @ x0 + slack
+    bl = A @ x0 - rng.uniform(3.0, 6.0, size=m) if two_sided else np.full(m, -np.inf)
+    c = rng.normal(size=n)
+    l, u = np.zeros(n), np.full(n, 5.0)
+
+    A_ub = [A]
+    b_ub = [bu]
+    if two_sided:
+        A_ub.append(-A)
+        b_ub.append(-bl)
+    res = linprog(c, A_ub=np.vstack(A_ub), b_ub=np.concatenate(b_ub),
+                  bounds=list(zip(l, u)), method="highs")
+    assert res.status == 0
+    prob = boxqp.make_boxqp(c, A, bl, bu, l, u)
+    return prob, res
+
+
+@pytest.mark.parametrize("two_sided", [False, True])
+def test_lp_matches_scipy(two_sided):
+    rng = np.random.default_rng(0)
+    prob, res = random_lp(rng, two_sided=two_sided)
+    scaled, sc = boxqp.ruiz_scale(prob)
+    opts = pdhg.PDHGOptions(tol=1e-6, max_iters=40_000)
+    st = pdhg.solve(scaled, opts)
+    x = np.asarray(st.x) * sc.d_col
+    obj = float(np.asarray(prob.c) @ x)
+    assert st.done.item()
+    assert obj == pytest.approx(res.fun, abs=2e-3, rel=2e-4)
+    # primal feasibility in original space
+    viol = np.asarray(boxqp.primal_residual(prob, jnp.asarray(x, prob.c.dtype)))
+    assert viol.max() < 5e-3
+
+
+def test_equality_rows():
+    # min -x1 - 2 x2  s.t. x1 + x2 == 1, 0 <= x <= 1  -> x = (0, 1), obj -2
+    prob = boxqp.make_boxqp(
+        c=[-1.0, -2.0], A=[[1.0, 1.0]], bl=[1.0], bu=[1.0], l=[0.0, 0.0], u=[1.0, 1.0]
+    )
+    st = pdhg.solve(prob, pdhg.PDHGOptions(tol=1e-7))
+    np.testing.assert_allclose(np.asarray(st.x), [0.0, 1.0], atol=1e-4)
+
+
+def test_qp_simplex_projection():
+    # min 1/2||x - z||^2 s.t. sum x = 1, x >= 0 : Euclidean projection.
+    rng = np.random.default_rng(3)
+    z = rng.normal(size=8)
+    # reference projection via sorting (Held et al.)
+    zs = np.sort(z)[::-1]
+    css = np.cumsum(zs) - 1.0
+    rho = np.nonzero(zs - css / (np.arange(8) + 1) > 0)[0][-1]
+    expected = np.maximum(z - css[rho] / (rho + 1), 0.0)
+
+    prob = boxqp.make_boxqp(
+        c=-z, q=np.ones(8), A=np.ones((1, 8)), bl=[1.0], bu=[1.0],
+        l=np.zeros(8), u=np.full(8, np.inf),
+    )
+    st = pdhg.solve(prob, pdhg.PDHGOptions(tol=1e-7))
+    np.testing.assert_allclose(np.asarray(st.x), expected, atol=1e-4)
+
+
+def test_batched_solve_matches_individual():
+    rng = np.random.default_rng(7)
+    probs, refs = zip(*[random_lp(rng, n=10, m=6) for _ in range(5)])
+    batch = jax.tree.map(lambda *xs: jnp.stack(xs), *probs)
+    scaled, sc = boxqp.ruiz_scale(batch)
+    st = pdhg.solve(scaled, pdhg.PDHGOptions(tol=1e-6, max_iters=40_000))
+    assert bool(st.done.all())
+    xs = np.asarray(st.x) * sc.d_col
+    for i, (prob, res) in enumerate(zip(probs, refs)):
+        obj = float(np.asarray(prob.c) @ xs[i])
+        assert obj == pytest.approx(res.fun, abs=2e-3, rel=2e-4)
+
+
+def test_warm_start_converges_faster():
+    rng = np.random.default_rng(11)
+    prob, _ = random_lp(rng)
+    scaled, _ = boxqp.ruiz_scale(prob)
+    opts = pdhg.PDHGOptions(tol=1e-6, max_iters=40_000)
+    st = pdhg.solve(scaled, opts)
+    cold_iters = int(st.k)
+    # perturb the objective slightly and re-solve warm
+    p2 = scaled.__class__(**{**scaled.__dict__, "c": scaled.c * 1.01})
+    st2 = pdhg.solve(p2, opts, state=st)
+    assert int(st2.k) <= cold_iters
+    assert st2.done.item()
+
+
+def test_solve_fixed_budget_runs():
+    rng = np.random.default_rng(13)
+    prob, res = random_lp(rng)
+    scaled, sc = boxqp.ruiz_scale(prob)
+    opts = pdhg.PDHGOptions(tol=0.0)  # never "done": pure fixed budget
+    st = pdhg.init_state(scaled, opts)
+    st = pdhg.solve_fixed(scaled, 200, opts, st)
+    x = np.asarray(st.x) * sc.d_col
+    obj = float(np.asarray(prob.c) @ x)
+    assert obj == pytest.approx(res.fun, rel=1e-2, abs=1e-2)
