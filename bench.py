@@ -1,0 +1,89 @@
+"""Benchmark: PH iterations/sec on the scenario batch, on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The measured quantity is the north-star metric from BASELINE.md: PH
+iterations per second at scale.  `vs_baseline` is the speedup over the
+reference's execution model — one sequential CPU LP solve per scenario
+per PH iteration (what each mpi-sppy rank does in solve_loop,
+ref:mpisppy/spopt.py:250-341) — estimated by timing scipy.linprog
+(HiGHS) on a sample of the same subproblems and scaling to the full
+scenario count.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def time_scipy_baseline(specs, sample=8):
+    """Mean seconds per scenario LP via scipy/HiGHS (sequential-CPU model)."""
+    from scipy.optimize import linprog
+
+    times = []
+    for sp in specs[:sample]:
+        A_ub, b_ub = [], []
+        for i in range(sp.A.shape[0]):
+            if np.isfinite(sp.bu[i]):
+                A_ub.append(sp.A[i]); b_ub.append(sp.bu[i])
+            if np.isfinite(sp.bl[i]):
+                A_ub.append(-sp.A[i]); b_ub.append(-sp.bl[i])
+        t0 = time.perf_counter()
+        res = linprog(sp.c, A_ub=np.array(A_ub), b_ub=np.array(b_ub),
+                      bounds=list(zip(sp.l, sp.u)), method="highs")
+        times.append(time.perf_counter() - t0)
+        assert res.status == 0
+    return float(np.mean(times))
+
+
+def main():
+    import jax
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+
+    num_scens = 5000
+    crops_multiplier = 4
+    names = farmer.scenario_names_creator(num_scens)
+    specs = [farmer.scenario_creator(nm, num_scens=num_scens,
+                                     crops_multiplier=crops_multiplier)
+             for nm in names]
+    batch = batch_mod.from_specs(specs)
+
+    opts = ph_mod.PHOptions(
+        default_rho=1.0, subproblem_windows=8,
+        pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40),
+    )
+    rho = np.ones(batch.num_nonants, np.float32)
+    state, _ = ph_mod.ph_iter0(batch, jax.numpy.asarray(rho), opts)
+
+    # warmup/compile
+    state = ph_mod.ph_iterk(batch, state, opts)
+    jax.block_until_ready(state.conv)
+
+    n_iters = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state = ph_mod.ph_iterk(batch, state, opts)
+    jax.block_until_ready(state.conv)
+    elapsed = time.perf_counter() - t0
+    iters_per_sec = n_iters / elapsed
+
+    # baseline: sequential CPU LP solves, one per scenario per iteration
+    sec_per_lp = time_scipy_baseline(specs)
+    baseline_iters_per_sec = 1.0 / (sec_per_lp * num_scens)
+
+    print(json.dumps({
+        "metric": f"ph_iters_per_sec_farmer_{num_scens}scen_"
+                  f"{batch.qp.c.shape[-1]}var",
+        "value": round(iters_per_sec, 3),
+        "unit": "iter/s",
+        "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

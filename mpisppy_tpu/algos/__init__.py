@@ -1,0 +1,1 @@
+# algos subpackage of mpisppy_tpu

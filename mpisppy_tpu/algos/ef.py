@@ -1,0 +1,153 @@
+###############################################################################
+# Extensive form: all scenarios as ONE BoxQP.
+#
+# The reference builds the EF as a Pyomo model with per-scenario blocks,
+# a probability-weighted objective, and reference-variable
+# nonanticipativity equality constraints
+# (ref:mpisppy/utils/sputils.py:143-357), then hands it to a MIP solver
+# (ref:mpisppy/opt/ef.py:75-104).  Here the EF is assembled as one
+# block-diagonal BoxQP — scenario blocks on the diagonal, nonant
+# equality rows x_{s,i} == x_{ref(s),i} linking them — and solved by the
+# same batched PDHG kernel (a single "scenario" of size S*n).  It is the
+# correctness oracle for the decomposition algorithms: PH's converged
+# objective must match the EF objective.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+
+from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.core.tree import ScenarioTree, two_stage_tree
+from mpisppy_tpu.ops import boxqp, pdhg
+
+
+@dataclasses.dataclass(frozen=True)
+class EFProblem:
+    """The assembled extensive form plus bookkeeping to read solutions."""
+
+    qp: boxqp.BoxQP           # scaled
+    scaling: boxqp.Scaling
+    n_per_scen: int
+    probs: np.ndarray         # (S,)
+    nonant_idx: np.ndarray    # (N,) columns within one scenario block
+    tree: ScenarioTree
+
+
+def build_ef(specs: list[ScenarioSpec],
+             tree: ScenarioTree | None = None,
+             dtype=jnp.float32,
+             scale: bool = True) -> EFProblem:
+    S = len(specs)
+    n = specs[0].c.shape[0]
+    nonant_idx = np.asarray(specs[0].nonant_idx, np.int64)
+    N = len(nonant_idx)
+    if tree is None:
+        tree = two_stage_tree(S, N)
+
+    probs = np.array([1.0 / S if sp.probability is None else sp.probability
+                      for sp in specs])
+
+    # Objective: sum_s p_s f_s  (block-concatenated variables).
+    c = np.concatenate([probs[s] * np.asarray(specs[s].c, np.float64)
+                        for s in range(S)])
+    q = np.concatenate([
+        probs[s] * (np.zeros(n) if specs[s].q is None
+                    else np.asarray(specs[s].q, np.float64))
+        for s in range(S)])
+    l = np.concatenate([np.asarray(sp.l, np.float64) for sp in specs])
+    u = np.concatenate([np.asarray(sp.u, np.float64) for sp in specs])
+
+    # Nonanticipativity: within each tree node, every member scenario's
+    # slot equals the first member's (reference-variable convention,
+    # ref:mpisppy/utils/sputils.py:300-357).
+    node_of_slot = tree.node_of_slot()  # (S, N)
+    link_rows = []
+    for node in range(tree.num_nodes):
+        for i in range(N):
+            members = np.nonzero(node_of_slot[:, i] == node)[0]
+            for s in members[1:]:
+                link_rows.append((members[0], s, i))
+
+    m_block = sum(sp.A.shape[0] for sp in specs)
+    m = m_block + len(link_rows)
+    A = np.zeros((m, S * n))
+    bl = np.empty(m)
+    bu = np.empty(m)
+    r = 0
+    for s, sp in enumerate(specs):
+        ms = sp.A.shape[0]
+        A[r:r + ms, s * n:(s + 1) * n] = sp.A
+        bl[r:r + ms] = sp.bl
+        bu[r:r + ms] = sp.bu
+        r += ms
+    for (s0, s, i) in link_rows:
+        A[r, s0 * n + nonant_idx[i]] = 1.0
+        A[r, s * n + nonant_idx[i]] = -1.0
+        bl[r] = bu[r] = 0.0
+        r += 1
+
+    qp = boxqp.make_boxqp(c, A, bl, bu, l, u, q=q, dtype=dtype)
+    if scale:
+        qp, scaling = boxqp.ruiz_scale(qp)
+    else:
+        scaling = boxqp.Scaling(d_row=np.ones(m), d_col=np.ones(S * n))
+    return EFProblem(qp=qp, scaling=scaling, n_per_scen=n, probs=probs,
+                     nonant_idx=nonant_idx, tree=tree)
+
+
+class ExtensiveForm:
+    """Direct EF solve — API parity with ref:mpisppy/opt/ef.py:16-155.
+
+    options: dict with optional 'tol', 'max_iters'.
+    """
+
+    def __init__(self, options, all_scenario_names, scenario_creator,
+                 scenario_creator_kwargs=None, tree=None, dtype=jnp.float32):
+        kwargs = scenario_creator_kwargs or {}
+        self.all_scenario_names = list(all_scenario_names)
+        self.specs = [scenario_creator(name, **kwargs)
+                      for name in self.all_scenario_names]
+        self.options = dict(options or {})
+        self.ef = build_ef(self.specs, tree=tree, dtype=dtype)
+        self._state = None
+
+    def solve_extensive_form(self) -> pdhg.PDHGState:
+        opts = pdhg.PDHGOptions(
+            tol=self.options.get("tol", 1e-6),
+            max_iters=self.options.get("max_iters", 100_000),
+        )
+        self._state = pdhg.solve(self.ef.qp, opts)
+        return self._state
+
+    @property
+    def x(self) -> np.ndarray:
+        """(S, n) per-scenario solution in original space."""
+        xs = np.asarray(self._state.x) * self.ef.scaling.d_col
+        return xs.reshape(len(self.specs), self.ef.n_per_scen)
+
+    def get_objective_value(self) -> float:
+        """EF objective in original space (ref:opt/ef.py:106)."""
+        x = self.x
+        val = 0.0
+        for s, sp in enumerate(self.specs):
+            qs = np.zeros_like(sp.c) if sp.q is None else sp.q
+            val += self.ef.probs[s] * float(
+                sp.c @ x[s] + 0.5 * x[s] @ (qs * x[s]))
+        return val
+
+    def get_root_solution(self) -> dict[str, float]:
+        """First-stage (ROOT) variable values (ref:opt/ef.py:121-135)."""
+        x = self.x
+        root_slots = np.nonzero(self.ef.tree.slot_stage == 1)[0]
+        return {f"x{self.ef.nonant_idx[i]}": float(x[0, self.ef.nonant_idx[i]])
+                for i in root_slots}
+
+    def nonants(self):
+        """Iterate (scenario_name, slot, value) (ref:opt/ef.py:138-147)."""
+        x = self.x
+        for s, name in enumerate(self.all_scenario_names):
+            for i, col in enumerate(self.ef.nonant_idx):
+                yield name, i, float(x[s, col])

@@ -1,0 +1,250 @@
+###############################################################################
+# Progressive Hedging, TPU-native.
+#
+# The reference's PH (ref:mpisppy/phbase.py, ref:mpisppy/opt/ph.py) is a
+# Python loop over per-scenario Pyomo models: Compute_Xbar does one MPI
+# Allreduce per tree node, Update_W is a loop over Pyomo Params, and
+# solve_loop dispatches each subproblem to a CPU MIP solver
+# sequentially.  Here ONE jitted step does all of it as tensor math over
+# the scenario batch:
+#
+#   x_non   = gather nonants from the batched PDHG iterates   (S, N)
+#   xbar    = node_average(x_non)           <- the psum/Allreduce analog
+#   W      += rho * (x_non - xbar)          (ref:phbase.py:301-326)
+#   conv    = E[ ||x_non - xbar||_1 ] / N   (ref:phbase.py:349-371)
+#   qp_eff  = base qp + W·x + rho/2 (x - xbar)^2 on nonant slots
+#             (ref:phbase.py:670-760 — exact diagonal prox, no
+#              linearization cuts needed: the kernel natively solves QPs)
+#   solver  = solve_fixed(qp_eff, n_windows) warm-started
+#
+# The step is compiled once and runs identically on 1 device or a pod
+# mesh — scenario-axis reductions become XLA all-reduces via sharding.
+# Iteration semantics match the reference: Iter0 solves WITHOUT W/prox
+# and seeds W = rho(x - xbar) (ref:phbase.py:829-946); the trivial bound
+# is the wait-and-see expectation E[min f_s] (ref:spopt.py:377).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import pdhg
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PHOptions:
+    """Static PH options (ref Config group ph_args,
+    ref:mpisppy/utils/config.py:250-315)."""
+
+    default_rho: float = 1.0
+    max_iterations: int = 100
+    conv_thresh: float = 1e-4          # ref 'convthresh'
+    subproblem_windows: int = 8        # PDHG restart windows per PH iter
+    iter0_windows: int = 400           # budget for the cold iter0 solves
+    pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(tol=1e-6)
+    smoothed: bool = False             # ref 'smoothed' / Update_z
+    smooth_beta: float = 0.2           # ref 'defaultPHbeta'
+    smooth_p: float = 0.0              # ref 'defaultPHp' (coef of (x-z)^2/2)
+    display_progress: bool = False
+    time_limit: float | None = None
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["solver", "W", "z", "xbar", "xsqbar", "conv", "rho"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class PHState:
+    solver: pdhg.PDHGState  # scaled-space subproblem iterates
+    W: Array                # (S, N) duals, original space
+    z: Array                # (S, N) smoothing state (unused unless smoothed)
+    xbar: Array             # (S, N) per-scenario view of node averages
+    xsqbar: Array           # (S, N) node averages of x^2 (for fixers)
+    conv: Array             # () scaled ||x - xbar||_1
+    rho: Array              # (N,) per-slot penalty
+
+
+def _xbar_w_conv(batch: ScenarioBatch, st: PHState, beta: float,
+                 smoothed: bool):
+    """Compute_Xbar + Update_W (+Update_z) + convergence_diff, fused.
+
+    Semantics match ref:mpisppy/phbase.py:301-371: W += rho*(x - xbar)
+    always; smoothing only updates z += beta*(x - z) (the (x-z)^2 term
+    enters the objective separately).  The convergence metric is the
+    probability-weighted mean of ||x - xbar||_1 per slot — identical to
+    the reference's unweighted mean for uniform probabilities, and the
+    correct generalization otherwise (padded p=0 scenarios drop out).
+    """
+    x_non = batch.nonants(st.solver.x)
+    xbar, _ = batch.node_average(x_non)
+    xsqbar, _ = batch.node_average(x_non * x_non)
+    W = st.W + st.rho * (x_non - xbar)
+    z = (1.0 - beta) * st.z + beta * x_non if smoothed else st.z
+    conv = batch.expectation(
+        jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / batch.num_nonants
+    return x_non, xbar, xsqbar, W, z, conv
+
+
+def _prox_qp(batch: ScenarioBatch, W: Array, xbar: Array, z: Array,
+             rho: Array, smooth_p: float):
+    """base objective + W·x + rho/2 (x-xbar)^2 [+ p/2 (x-z)^2] on nonant
+    slots (ref:mpisppy/phbase.py:670-760, exact instead of cut-based)."""
+    lin = W - rho * xbar - smooth_p * z
+    quad = jnp.broadcast_to(rho + smooth_p, xbar.shape)
+    return batch.with_nonant_linear_quad(lin, quad)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
+    """Iter0: plain scenario solves, xbar, W seed, trivial bound
+    (ref:mpisppy/phbase.py:829-946)."""
+    st0 = pdhg.init_state(batch.qp, opts.pdhg)
+    solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
+    obj = batch.objective(solver.x)
+    trivial_bound = batch.expectation(obj)
+    zeros = jnp.zeros((batch.num_scenarios, batch.num_nonants),
+                      batch.qp.c.dtype)
+    st = PHState(solver=solver, W=zeros, z=zeros, xbar=zeros, xsqbar=zeros,
+                 conv=jnp.asarray(jnp.inf, batch.qp.c.dtype), rho=rho)
+    x_non, xbar, xsqbar, W, z, conv = _xbar_w_conv(
+        batch, st, opts.smooth_beta, False)
+    return dataclasses.replace(st, W=W, xbar=xbar, xsqbar=xsqbar,
+                               conv=conv), trivial_bound
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def ph_iterk(batch: ScenarioBatch, st: PHState, opts: PHOptions) -> PHState:
+    """One PH iteration: solve subproblems with current (W, xbar), then
+    refresh xbar/W/conv from the new iterates
+    (ref:mpisppy/phbase.py:949-1061, with xbar computed *after* the
+    solves so the returned state is self-consistent)."""
+    smooth_p = opts.smooth_p if opts.smoothed else 0.0
+    qp_eff = _prox_qp(batch, st.W, st.xbar, st.z, st.rho, smooth_p)
+    solver = pdhg.solve_fixed(qp_eff, opts.subproblem_windows, opts.pdhg,
+                              st.solver)
+    st = dataclasses.replace(st, solver=solver)
+    x_non, xbar, xsqbar, W, z, conv = _xbar_w_conv(
+        batch, st, opts.smooth_beta, opts.smoothed)
+    return dataclasses.replace(st, W=W, z=z, xbar=xbar, xsqbar=xsqbar,
+                               conv=conv)
+
+
+@jax.jit
+def ph_eobjective(batch: ScenarioBatch, st: PHState) -> Array:
+    """E[f_s(x_s)] at current iterates (ref:mpisppy/spopt.py:344-376)."""
+    return batch.expectation(batch.objective(st.solver.x))
+
+
+class PH:
+    """Host-side PH driver (ref:mpisppy/opt/ph.py:24-76).
+
+    Supports the reference's extension plane: `extensions` is an object
+    (or class) with the hook methods of ref:mpisppy/extensions/extension.py;
+    missing hooks are skipped.  `converger` gets is_converged(self).
+    `spcomm` (set by the cylinder layer) gets sync()/is_converged().
+    """
+
+    def __init__(self, options: PHOptions, batch: ScenarioBatch,
+                 scenario_names=None, rho: Array | float | None = None,
+                 extensions=None, converger=None, rho_setter=None):
+        self.options = options
+        self.batch = batch
+        self.scenario_names = scenario_names or [
+            f"scen{i}" for i in range(batch.num_real)]
+        if rho is None:
+            rho = options.default_rho
+        rho_arr = jnp.broadcast_to(
+            jnp.asarray(rho, batch.qp.c.dtype), (batch.num_nonants,))
+        if rho_setter is not None:
+            rho_arr = jnp.asarray(rho_setter(batch), batch.qp.c.dtype)
+        self.rho = rho_arr
+        self.extobject = extensions(self) if isinstance(extensions, type) \
+            else extensions
+        self.converger_object = converger(self) if isinstance(converger, type) \
+            else converger
+        self.spcomm = None
+        self.state: PHState | None = None
+        self.trivial_bound: float | None = None
+        self._iter = 0
+
+    # -- extension callout plumbing (ref:extensions/extension.py:18-151) --
+    def _ext(self, hook: str):
+        obj = self.extobject
+        if obj is not None and hasattr(obj, hook):
+            getattr(obj, hook)()
+
+    @property
+    def local_scenarios(self):  # parity helper for extensions
+        return self.scenario_names
+
+    def Eobjective(self) -> float:
+        return float(ph_eobjective(self.batch, self.state))
+
+    def Iter0(self) -> float:
+        self._ext("pre_iter0")
+        self.state, tb = ph_iter0(self.batch, self.rho, self.options)
+        self.trivial_bound = float(tb)
+        self._ext("post_iter0")
+        global_toc(f"PH Iter0: trivial bound = {self.trivial_bound:.6g}",
+                   self.options.display_progress)
+        return self.trivial_bound
+
+    def iterk_loop(self):
+        import time
+        t0 = time.time()
+        for k in range(1, self.options.max_iterations + 1):
+            self._iter = k
+            self._ext("miditer")
+            self.state = ph_iterk(self.batch, self.state, self.options)
+            conv = float(self.state.conv)
+            self._ext("enditer")
+            if self.spcomm is not None:
+                self.spcomm.sync()
+            global_toc(f"PH iter {k}: conv = {conv:.3e}",
+                       self.options.display_progress)
+            if conv <= self.options.conv_thresh:
+                global_toc(f"PH converged at iter {k} (conv={conv:.3e})",
+                           self.options.display_progress)
+                break
+            if (self.converger_object is not None
+                    and self.converger_object.is_converged()):
+                break
+            if self.spcomm is not None and self.spcomm.is_converged():
+                break
+            if (self.options.time_limit is not None
+                    and time.time() - t0 > self.options.time_limit):
+                break
+        return float(self.state.conv)
+
+    def post_loops(self) -> float:
+        self._ext("post_everything")
+        return self.Eobjective()
+
+    def ph_main(self):
+        """Returns (conv, Eobj, trivial_bound) (ref:opt/ph.py:31-76)."""
+        tb = self.Iter0()
+        conv = self.iterk_loop()
+        eobj = self.post_loops()
+        return conv, eobj, tb
+
+    # -- solution access (ref:spbase.py:561-672 analogs) -----------------
+    def nonant_values(self) -> np.ndarray:
+        """(num_nodes, N) converged per-node nonant values (xbar)."""
+        _, nodes = self.batch.node_average(
+            self.batch.nonants(self.state.solver.x))
+        return np.asarray(nodes)
+
+    def first_stage_solution(self) -> np.ndarray:
+        """(n_root_slots,) root-node nonant values."""
+        nodes = self.nonant_values()
+        root = np.nonzero(self.batch.tree.slot_stage == 1)[0]
+        return nodes[0, root]

@@ -1,0 +1,301 @@
+###############################################################################
+# Scenario batch: the data plane of the framework.
+#
+# The reference instantiates one Pyomo ConcreteModel per scenario via a
+# user `scenario_creator` and keeps per-variable maps into it
+# (ref:mpisppy/spbase.py:259-334).  Here a scenario is a declarative
+# numpy spec of a BoxQP plus a nonant column map, and a *batch* of
+# scenarios is one pytree of stacked arrays with a leading scenario axis
+# — HBM-resident, shardable over a mesh axis, and consumed whole by the
+# batched PDHG kernel.  This is the TPU answer to the reference's
+# "scenarios_creator + attach _mpisppy_data" glue:
+#
+#   specs (host, numpy)  --from_specs-->  ScenarioBatch (device pytree)
+#
+# Ruiz equilibration is applied at build time; PH-layer math (prox
+# terms, W vectors, xbar averaging) happens in ORIGINAL variable space
+# and is mapped into the scaled space via the stored column scalings, so
+# averaging across scenarios stays meaningful even with per-scenario
+# scalings.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.ops.boxqp import BoxQP, ruiz_scale
+from mpisppy_tpu.core.tree import ScenarioTree, two_stage_tree
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    """One scenario's subproblem in original (unscaled) space.
+
+    The analog of the reference's scenario_creator output
+    (ref:examples/farmer/farmer.py:31-89): a model plus its nonant
+    declaration (`sputils.attach_root_node`) and probability.
+
+    nonant_idx: column indices of nonanticipative variables, ordered
+    stage-major for multistage problems.  All scenarios of a batch must
+    use the same column layout.
+    """
+
+    name: str
+    c: np.ndarray
+    A: np.ndarray
+    bl: np.ndarray
+    bu: np.ndarray
+    l: np.ndarray  # noqa: E741
+    u: np.ndarray
+    nonant_idx: np.ndarray
+    q: np.ndarray | None = None
+    probability: float | None = None  # None -> uniform
+    integer: np.ndarray | None = None  # bool over all n columns
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["qp", "d_col", "d_row", "d_non", "p", "nonant_idx",
+                 "node_of_slot", "integer_slot"],
+    meta_fields=["tree", "num_real"],
+)
+@dataclasses.dataclass(frozen=True)
+class ScenarioBatch:
+    """All scenarios of a problem as one device pytree.
+
+    qp:           scaled, batched BoxQP (leading axis S; A may be (m,n)
+                  shared when the constraint matrix is deterministic).
+    d_col/d_row:  Ruiz scalings; x_orig = d_col * x_scaled.  (n,)/(m,) if
+                  shared across the batch else (S,n)/(S,m).
+    d_non:        d_col gathered at nonant columns ((N,) or (S,N)).
+    p:            (S,) scenario probabilities (padded scenarios get 0).
+    nonant_idx:   (N,) int32 nonant column indices (shared layout).
+    node_of_slot: (S, N) int32 owning tree-node id per scenario slot.
+    integer_slot: (N,) bool integrality of each nonant slot.
+    tree:         static ScenarioTree metadata.
+    num_real:     scenarios before mesh padding.
+    """
+
+    qp: BoxQP
+    d_col: Array
+    d_row: Array
+    d_non: Array
+    p: Array
+    nonant_idx: Array
+    node_of_slot: Array
+    integer_slot: Array
+    tree: ScenarioTree
+    num_real: int
+
+    @property
+    def num_scenarios(self) -> int:
+        return self.qp.c.shape[0]
+
+    @property
+    def num_nonants(self) -> int:
+        return int(self.nonant_idx.shape[0])
+
+    # ---- original-space views -------------------------------------------
+    def nonants(self, x_scaled: Array) -> Array:
+        """(S, N) original-space nonant values from scaled iterates."""
+        return self.d_non * x_scaled[..., self.nonant_idx]
+
+    def objective(self, x_scaled: Array) -> Array:
+        """Per-scenario ORIGINAL objective.  Scaled c,q absorb d_col, so
+        evaluating the scaled quadratic at scaled x is the original value."""
+        return jnp.sum(self.qp.c * x_scaled + 0.5 * self.qp.q * x_scaled**2,
+                       axis=-1)
+
+    def node_average(self, vals: Array, weights: Array | None = None):
+        """Probability-weighted mean of per-scenario slot values within
+        each owning tree node — the framework's ONE nonanticipativity
+        reduction, replacing the reference's per-node-communicator
+        Allreduces (ref:mpisppy/phbase.py:32-112, spbase.py:337-379).
+
+        vals: (S, N).  weights: optional (S, N) per-(scenario, slot)
+        weights (variable-probability support,
+        ref:mpisppy/spbase.py:398-441); defaults to p broadcast.
+
+        Returns (avg_per_scen (S, N), avg_nodes (num_nodes, N)).  Under
+        jit over a sharded scenario axis the sums become cross-device
+        all-reduces automatically.
+        """
+        w = self.p[:, None] if weights is None else weights
+        tiny = jnp.asarray(1e-30, vals.dtype)
+        if self.tree.num_nodes == 1:
+            num = jnp.sum(w * vals, axis=0)
+            den = jnp.sum(jnp.broadcast_to(w, vals.shape), axis=0)
+            avg = num / jnp.maximum(den, tiny)
+            return jnp.broadcast_to(avg, vals.shape), avg[None, :]
+        N = self.num_nonants
+        nseg = self.tree.num_nodes * N
+        key = (self.node_of_slot * N + jnp.arange(N)[None, :]).reshape(-1)
+        num = jax.ops.segment_sum((w * vals).reshape(-1), key,
+                                  num_segments=nseg)
+        den = jax.ops.segment_sum(
+            jnp.broadcast_to(w, vals.shape).reshape(-1), key,
+            num_segments=nseg)
+        avg_nodes = (num / jnp.maximum(den, tiny)).reshape(
+            self.tree.num_nodes, N)
+        avg_scen = jnp.take_along_axis(avg_nodes, self.node_of_slot, axis=0)
+        return avg_scen, avg_nodes
+
+    def expectation(self, vals: Array) -> Array:
+        """E[vals] over scenarios — Eobjective/Ebound style reduction
+        (ref:mpisppy/spopt.py:344-436)."""
+        return jnp.sum(self.p * vals)
+
+    def with_nonant_linear_quad(self, w: Array, rho_quad: Array) -> BoxQP:
+        """Return a qp whose objective adds, in ORIGINAL space,
+        w·x_non + 1/2 x_non' diag(rho_quad) x_non over the nonant slots.
+
+        This is the whole PH objective plumbing
+        (ref:mpisppy/phbase.py:670-760) reduced to two elementwise maps:
+        original-space linear/diagonal-quadratic terms transform into
+        scaled space as c += d_non*w and q += d_non^2*rho (then scattered
+        to full columns).
+        """
+        c_add = jnp.zeros_like(self.qp.c).at[..., self.nonant_idx].add(
+            self.d_non * w)
+        q_add = jnp.zeros_like(self.qp.q).at[..., self.nonant_idx].add(
+            self.d_non * self.d_non * rho_quad)
+        return dataclasses.replace(self.qp, c=self.qp.c + c_add,
+                                   q=self.qp.q + q_add)
+
+    def with_fixed_nonants(self, xhat_nodes: Array) -> BoxQP:
+        """Fix each scenario's nonants to its tree nodes' values
+        (original space) by collapsing the box to a point — the batched
+        analog of _fix_nonants (ref:mpisppy/spopt.py:633-674).
+
+        xhat_nodes: (num_nodes, N) per-node candidate values, or (N,) for
+        the two-stage case.
+        """
+        if xhat_nodes.ndim == 2:
+            xhat = jnp.take_along_axis(xhat_nodes, self.node_of_slot, axis=0)
+        else:
+            xhat = jnp.broadcast_to(xhat_nodes, self.node_of_slot.shape)
+        xs = xhat / self.d_non  # to scaled space; (S, N)
+        S, n = self.qp.c.shape
+        l_full = jnp.broadcast_to(self.qp.l, (S, n))
+        u_full = jnp.broadcast_to(self.qp.u, (S, n))
+        return dataclasses.replace(
+            self.qp,
+            l=l_full.at[:, self.nonant_idx].set(xs),
+            u=u_full.at[:, self.nonant_idx].set(xs),
+        )
+
+
+def from_specs(specs: list[ScenarioSpec],
+               tree: ScenarioTree | None = None,
+               dtype=jnp.float32,
+               scale: bool = True) -> ScenarioBatch:
+    """Stack scenario specs into a device batch (the scenario compiler)."""
+    if not specs:
+        raise ValueError("need at least one scenario")
+    n = specs[0].c.shape[0]
+    nonant_idx = np.asarray(specs[0].nonant_idx, np.int32)
+    for sp in specs:
+        if sp.c.shape[0] != n or not np.array_equal(
+                np.asarray(sp.nonant_idx, np.int32), nonant_idx):
+            raise ValueError(f"scenario {sp.name}: inconsistent layout")
+
+    if tree is None:
+        tree = two_stage_tree(len(specs), len(nonant_idx))
+    if tree.num_nonant_slots != len(nonant_idx):
+        raise ValueError("nonant_idx length does not match tree slots")
+    if tree.num_scenarios != len(specs):
+        raise ValueError("scenario count does not match tree")
+
+    probs = np.array([1.0 / len(specs) if sp.probability is None
+                      else sp.probability for sp in specs])
+    if not np.isclose(probs.sum(), 1.0, atol=1e-6):
+        # same check as ref:mpisppy/spbase.py:461-506
+        raise ValueError(f"scenario probabilities sum to {probs.sum()}")
+
+    def stack(field):
+        arrs = [np.asarray(getattr(sp, field), np.float64) for sp in specs]
+        first = arrs[0]
+        if all(a.shape == first.shape and np.array_equal(a, first)
+               for a in arrs[1:]):
+            return first  # shared across the batch (broadcasts)
+        return np.stack(arrs)
+
+    c = np.stack([np.asarray(sp.c, np.float64) for sp in specs])
+    q = np.stack([np.zeros(n) if sp.q is None else np.asarray(sp.q, np.float64)
+                  for sp in specs])
+    A = stack("A")
+    qp = BoxQP(
+        c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype),
+        A=jnp.asarray(A, dtype),
+        bl=jnp.asarray(stack("bl"), dtype), bu=jnp.asarray(stack("bu"), dtype),
+        l=jnp.asarray(stack("l"), dtype), u=jnp.asarray(stack("u"), dtype),
+    )
+    if scale:
+        qp, scaling = ruiz_scale(qp)
+        d_col, d_row = scaling.d_col, scaling.d_row
+    else:
+        d_col = np.ones(A.shape[:-2] + (n,))
+        d_row = np.ones(A.shape[:-1])
+    d_col_j = jnp.asarray(d_col, dtype)
+    d_row_j = jnp.asarray(d_row, dtype)
+
+    integer = np.zeros(n, bool)
+    if specs[0].integer is not None:
+        integer = np.asarray(specs[0].integer, bool)
+
+    return ScenarioBatch(
+        qp=qp,
+        d_col=d_col_j,
+        d_row=d_row_j,
+        d_non=d_col_j[..., nonant_idx] if d_col_j.ndim > 1
+        else d_col_j[nonant_idx],
+        p=jnp.asarray(probs, dtype),
+        nonant_idx=jnp.asarray(nonant_idx),
+        node_of_slot=jnp.asarray(tree.node_of_slot()),
+        integer_slot=jnp.asarray(integer[nonant_idx]),
+        tree=tree,
+        num_real=len(specs),
+    )
+
+
+def pad_to_multiple(batch: ScenarioBatch, multiple: int) -> ScenarioBatch:
+    """Pad the scenario axis so it divides the mesh size.  Padded rows
+    duplicate the last scenario with probability 0, so every p-weighted
+    reduction (xbar, bounds, convergence) is unchanged."""
+    S = batch.num_scenarios
+    pad = (-S) % multiple
+    if pad == 0:
+        return batch
+
+    def pad_leading(x, batched_ndim):
+        """Pad only fields that actually carry the scenario axis (shared
+        fields are identified by ndim, not shape[0], so m==S or n==S
+        cannot misfire)."""
+        if x.ndim != batched_ndim:
+            return x
+        reps = jnp.repeat(x[-1:], pad, axis=0)
+        return jnp.concatenate([x, reps], axis=0)
+
+    qp = batch.qp
+    qp = dataclasses.replace(
+        qp,
+        c=pad_leading(qp.c, 2), q=pad_leading(qp.q, 2),
+        A=pad_leading(qp.A, 3),
+        bl=pad_leading(qp.bl, 2), bu=pad_leading(qp.bu, 2),
+        l=pad_leading(qp.l, 2), u=pad_leading(qp.u, 2),
+    )
+    return dataclasses.replace(
+        batch,
+        qp=qp,
+        d_col=pad_leading(batch.d_col, 2),
+        d_row=pad_leading(batch.d_row, 2),
+        d_non=pad_leading(batch.d_non, 2),
+        p=jnp.concatenate([batch.p, jnp.zeros(pad, batch.p.dtype)]),
+        node_of_slot=pad_leading(batch.node_of_slot, 2),
+    )

@@ -45,7 +45,7 @@ Array = jax.Array
 class PDHGOptions:
     """Static solver options (hashable: safe as a jit static arg)."""
 
-    tol: float = 1e-6
+    tol: float = 1e-6  # floored at 5*eps of the working dtype at solve time
     max_iters: int = 20_000
     restart_period: int = 40
     omega0: float = 1.0
@@ -84,9 +84,17 @@ def _bshape(p: BoxQP):
 
 
 def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
-    """Power iteration for ||A||_2, batch-aware."""
-    n = p.c.shape[-1]
-    v = jnp.ones_like(p.c) / jnp.sqrt(jnp.asarray(n, p.c.dtype))
+    """Power iteration for ||A||_2, batch-aware.
+
+    Seeded with a fixed PRNG vector (an all-ones seed lies in null(A'A)
+    for difference-row matrices — exactly the shape of nonanticipativity
+    rows — and collapses the iterate to zero).  The result is floored by
+    the max row/column 2-norms, both guaranteed lower bounds on ||A||_2,
+    so a degenerate iterate can never produce an underestimate that makes
+    tau explode.
+    """
+    v = jax.random.normal(jax.random.PRNGKey(7), p.c.shape, p.c.dtype)
+    v = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
 
     def body(_, carry):
         v, _ = carry
@@ -96,7 +104,11 @@ def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
         return w / nrm, nrm[..., 0]
 
     _, lam = jax.lax.fori_loop(0, iters, body, (v, jnp.ones(_bshape(p), p.c.dtype)))
-    return jnp.maximum(jnp.sqrt(lam), 1e-12)
+    row_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-1), axis=-1))
+    col_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-2), axis=-1))
+    lb = jnp.maximum(jnp.maximum(row_lb, col_lb), 1e-12)
+    # lb broadcasts when A is shared across a batched c
+    return jnp.maximum(jnp.sqrt(lam), lb)
 
 
 def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
@@ -151,6 +163,13 @@ def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     yr = jnp.where(take_avg, ya, st.y)
     score = jnp.minimum(score_a, score_c)
 
+    # Dtype-aware tolerance floor: relative KKT residuals near eps are
+    # unreachable in the working precision; without a floor a too-tight
+    # `tol` silently burns max_iters with done=False.  5*eps (~6e-7 in
+    # f32) sits below the 1e-6 default so ordinary tolerances are
+    # honored exactly.
+    tol = jnp.maximum(opts.tol, 5.0 * jnp.finfo(st.x.dtype).eps)
+
     # Primal-weight adaptation (theta = 0.5 log-space smoothing).
     dx = jnp.linalg.norm(xr - st.x_anchor, axis=-1)
     dy = jnp.linalg.norm(yr - st.y_anchor, axis=-1)
@@ -171,7 +190,7 @@ def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
         y_anchor=jnp.where(keep[..., None], st.y_anchor, yr),
         omega=jnp.where(keep, st.omega, omega),
         score=jnp.where(keep, st.score, score),
-        done=keep | (score <= opts.tol),
+        done=keep | (score <= tol),
     )
 
 

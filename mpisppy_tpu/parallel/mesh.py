@@ -1,0 +1,79 @@
+###############################################################################
+# The device mesh: this framework's entire "MPI".
+#
+# The reference's communication layer is mpi4py plus a numpy mock
+# (ref:mpisppy/MPI.py:10-90), with scenarios block-partitioned over a
+# cylinder communicator (ref:mpisppy/spbase.py:188-220) and every
+# reduction an explicit Allreduce (ref:mpisppy/phbase.py:88-92,
+# ref:mpisppy/spopt.py:344-556).  The TPU design needs none of that
+# machinery: scenario arrays are sharded over a 1-D mesh axis 'scen'
+# (ICI/DCN underneath), every jitted step takes sharded inputs, and XLA's
+# SPMD partitioner turns the p-weighted reductions into all-reduce
+# collectives automatically.  One seam — `shard_batch` — replaces the
+# whole of MPI.py: called with a 1-device mesh it is the "mock" serial
+# backend; with a TPU pod mesh it is the production backend.  Tests run
+# the same code on a virtual 8-device CPU mesh
+# (ref:.github/workflows/test_pr_and_main.yml:27-48 analog).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SCEN_AXIS = "scen"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the scenario axis.  n_devices=None uses all
+    available devices; n_devices=1 is the serial/mock path."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SCEN_AXIS,))
+
+
+def scen_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(SCEN_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a ScenarioBatch on the mesh: scenario-major arrays sharded on
+    their leading axis, shared arrays replicated.  Scenario-carrying
+    fields are recognized by leading-axis length == num_scenarios with the
+    field's batched rank (mirrors pad_to_multiple's ndim logic)."""
+    S = batch.num_scenarios
+    if S % mesh.size != 0:
+        raise ValueError(
+            f"{S} scenarios not divisible by mesh size {mesh.size}; "
+            "use core.batch.pad_to_multiple first")
+    shard = scen_sharding(mesh)
+    repl = replicated(mesh)
+
+    def put(x, batched_ndim):
+        return jax.device_put(x, shard if x.ndim == batched_ndim else repl)
+
+    qp = batch.qp
+    qp = dataclasses.replace(
+        qp,
+        c=put(qp.c, 2), q=put(qp.q, 2), A=put(qp.A, 3),
+        bl=put(qp.bl, 2), bu=put(qp.bu, 2), l=put(qp.l, 2), u=put(qp.u, 2),
+    )
+    return dataclasses.replace(
+        batch,
+        qp=qp,
+        d_col=put(batch.d_col, 2),
+        d_row=put(batch.d_row, 2),
+        d_non=put(batch.d_non, 2),
+        p=jax.device_put(batch.p, shard),
+        nonant_idx=jax.device_put(batch.nonant_idx, repl),
+        node_of_slot=put(batch.node_of_slot, 2),
+        integer_slot=jax.device_put(batch.integer_slot, repl),
+    )

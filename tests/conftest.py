@@ -10,3 +10,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The env var alone is not enough when a TPU plugin (e.g. the axon
+# tunnel) registered itself with higher priority — pin the platform via
+# the config API too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

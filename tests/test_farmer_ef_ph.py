@@ -1,0 +1,98 @@
+# End-to-end farmer: EF known answer + PH convergence to the EF objective.
+# The TPU analog of ref:mpisppy/tests/test_ef_ph.py — but our solver is
+# in-repo, so we can also oracle against scipy.linprog.
+import numpy as np
+import pytest
+from scipy.optimize import linprog
+
+from mpisppy_tpu.algos import ef as ef_mod
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg
+
+FARMER_EF_OBJ = -108390.0  # classic Birge & Louveaux value
+
+
+def farmer_specs(num_scens=3, **kw):
+    names = farmer.scenario_names_creator(num_scens)
+    return [farmer.scenario_creator(nm, num_scens=num_scens, **kw)
+            for nm in names]
+
+
+def scipy_ef_solve(specs):
+    """Independent EF oracle via scipy.linprog on the assembled EF."""
+    efp = ef_mod.build_ef(specs, scale=False)
+    qp = efp.qp
+    c = np.asarray(qp.c, np.float64)
+    A = np.asarray(qp.A, np.float64)
+    bl, bu = np.asarray(qp.bl, np.float64), np.asarray(qp.bu, np.float64)
+    l, u = np.asarray(qp.l, np.float64), np.asarray(qp.u, np.float64)
+    A_ub, b_ub, A_eq, b_eq = [], [], [], []
+    for i in range(A.shape[0]):
+        if bl[i] == bu[i]:
+            A_eq.append(A[i]); b_eq.append(bu[i])
+        else:
+            if np.isfinite(bu[i]):
+                A_ub.append(A[i]); b_ub.append(bu[i])
+            if np.isfinite(bl[i]):
+                A_ub.append(-A[i]); b_ub.append(-bl[i])
+    res = linprog(c, A_ub=np.array(A_ub), b_ub=np.array(b_ub),
+                  A_eq=np.array(A_eq) if A_eq else None,
+                  b_eq=np.array(b_eq) if b_eq else None,
+                  bounds=list(zip(l, u)), method="highs")
+    assert res.status == 0
+    return res.fun, res.x
+
+
+def test_farmer_ef_known_answer():
+    specs = farmer_specs(3)
+    obj, _ = scipy_ef_solve(specs)
+    assert obj == pytest.approx(FARMER_EF_OBJ, abs=1.0)
+
+
+def test_farmer_ef_pdhg_matches_scipy():
+    specs = farmer_specs(3)
+    sobj, _ = scipy_ef_solve(specs)
+    efobj = ef_mod.ExtensiveForm({"tol": 1e-7, "max_iters": 200_000},
+                                 farmer.scenario_names_creator(3),
+                                 farmer.scenario_creator,
+                                 {"num_scens": 3})
+    st = efobj.solve_extensive_form()
+    assert bool(st.done.all())
+    assert efobj.get_objective_value() == pytest.approx(sobj, rel=2e-3)
+    # first-stage solution: WHEAT 170, CORN 80, BEETS 250 (textbook)
+    x1 = [efobj.get_root_solution()[f"x{i}"] for i in range(3)]
+    np.testing.assert_allclose(x1, [170.0, 80.0, 250.0], atol=2.0)
+
+
+def test_farmer_ph_converges_to_ef():
+    specs = farmer_specs(3)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    opts = ph_mod.PHOptions(
+        default_rho=1.0, max_iterations=150, conv_thresh=5e-2,
+        subproblem_windows=10,
+        pdhg=pdhg.PDHGOptions(tol=1e-7, restart_period=40),
+    )
+    algo = ph_mod.PH(opts, b)
+    conv, eobj, tbound = algo.ph_main()
+    # trivial bound = wait-and-see expectation, a valid lower bound
+    assert tbound <= sobj + 1.0
+    assert conv <= opts.conv_thresh
+    # converged nonants agree across scenarios and with the EF solution
+    x1 = algo.first_stage_solution()
+    np.testing.assert_allclose(x1, [170.0, 80.0, 250.0], atol=5.0)
+
+
+def test_farmer_ph_larger_scenarios():
+    # 12 scenarios (groups > 0 use the seeded RNG noise path)
+    specs = farmer_specs(12)
+    b = batch_mod.from_specs(specs)
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=120,
+                            conv_thresh=1e-1, subproblem_windows=8)
+    algo = ph_mod.PH(opts, b)
+    conv, eobj, tbound = algo.ph_main()
+    sobj, _ = scipy_ef_solve(specs)
+    assert tbound <= sobj + 1.0
+    assert eobj == pytest.approx(sobj, rel=5e-3)

@@ -82,7 +82,8 @@ def test_batched_solve_matches_individual():
     probs, refs = zip(*[random_lp(rng, n=10, m=6) for _ in range(5)])
     batch = jax.tree.map(lambda *xs: jnp.stack(xs), *probs)
     scaled, sc = boxqp.ruiz_scale(batch)
-    st = pdhg.solve(scaled, pdhg.PDHGOptions(tol=1e-6, max_iters=40_000))
+    # instance 0 of this seed has a slow f32 tail (~60k iters on CPU)
+    st = pdhg.solve(scaled, pdhg.PDHGOptions(tol=1e-6, max_iters=120_000))
     assert bool(st.done.all())
     xs = np.asarray(st.x) * sc.d_col
     for i, (prob, res) in enumerate(zip(probs, refs)):
@@ -97,18 +98,41 @@ def test_warm_start_converges_faster():
     opts = pdhg.PDHGOptions(tol=1e-6, max_iters=40_000)
     st = pdhg.solve(scaled, opts)
     cold_iters = int(st.k)
-    # perturb the objective slightly and re-solve warm
+    # perturb the objective slightly and re-solve warm.  Warm starting
+    # carries no guarantee of strictly fewer iterations, so assert
+    # convergence plus a loose 2x bound (ADVICE r1).
     p2 = scaled.__class__(**{**scaled.__dict__, "c": scaled.c * 1.01})
     st2 = pdhg.solve(p2, opts, state=st)
-    assert int(st2.k) <= cold_iters
     assert st2.done.item()
+    assert int(st2.k) <= 2 * cold_iters
+
+
+def test_difference_rows_norm_not_degenerate():
+    # Rows that sum to zero (x_i - x_j form, the exact shape of
+    # nonanticipativity constraints) put the all-ones vector in null(A'A);
+    # regression for the ADVICE r1 finding that the power iteration then
+    # collapsed and the solve diverged.  The 2-row difference matrix has
+    # sigma_max = sqrt(3) STRICTLY greater than the max row norm sqrt(2),
+    # so this assertion requires the power iteration itself to work (the
+    # row-norm floor alone would return sqrt(2)).
+    prob = boxqp.make_boxqp(
+        c=[-1.0, 0.0, 0.0], A=[[1.0, -1.0, 0.0], [0.0, 1.0, -1.0]],
+        bl=[-np.inf, -np.inf], bu=[0.0, 0.0],
+        l=[0.0, 0.0, 0.0], u=[1.0, 1.0, 1.0],
+    )
+    est = float(pdhg.estimate_norm(prob))
+    assert est == pytest.approx(np.sqrt(3.0), rel=1e-3)
+    st = pdhg.solve(prob, pdhg.PDHGOptions(tol=1e-6))
+    assert st.done.item()
+    # min -x1 s.t. x1 <= x2 <= x3, x in [0,1]: optimum all ones
+    np.testing.assert_allclose(np.asarray(st.x), [1.0, 1.0, 1.0], atol=1e-4)
 
 
 def test_solve_fixed_budget_runs():
     rng = np.random.default_rng(13)
     prob, res = random_lp(rng)
     scaled, sc = boxqp.ruiz_scale(prob)
-    opts = pdhg.PDHGOptions(tol=0.0)  # never "done": pure fixed budget
+    opts = pdhg.PDHGOptions(tol=0.0)  # tol floors at 5*eps; fixed budget
     st = pdhg.init_state(scaled, opts)
     st = pdhg.solve_fixed(scaled, 200, opts, st)
     x = np.asarray(st.x) * sc.d_col

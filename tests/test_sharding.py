@@ -1,0 +1,96 @@
+# Multi-device sharding: scenario-axis parity sharded-vs-unsharded, and
+# proof that cross-device collectives actually appear in the compiled
+# program (the analog of the reference's Allreduce seam,
+# ref:mpisppy/phbase.py:88-92).  Runs on the virtual 8-device CPU mesh
+# from conftest.py.
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.parallel import mesh as mesh_mod
+
+
+def build_batch(num_scens):
+    names = farmer.scenario_names_creator(num_scens)
+    specs = [farmer.scenario_creator(nm, num_scens=num_scens)
+             for nm in names]
+    return batch_mod.from_specs(specs)
+
+
+def test_devices_available():
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+
+
+def test_sharded_ph_matches_unsharded():
+    b = build_batch(16)
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=10,
+                            conv_thresh=0.0, subproblem_windows=4)
+
+    # unsharded (1-device mesh = the serial/mock path)
+    m1 = mesh_mod.make_mesh(1)
+    b1 = mesh_mod.shard_batch(b, m1)
+    algo1 = ph_mod.PH(opts, b1)
+    algo1.Iter0()
+    for _ in range(5):
+        algo1.state = ph_mod.ph_iterk(b1, algo1.state, opts)
+
+    # sharded over all 8 devices
+    m8 = mesh_mod.make_mesh(8)
+    b8 = mesh_mod.shard_batch(b, m8)
+    algo8 = ph_mod.PH(opts, b8)
+    algo8.Iter0()
+    for _ in range(5):
+        algo8.state = ph_mod.ph_iterk(b8, algo8.state, opts)
+
+    # same math, different partitioning -> near-identical trajectories
+    # (tolerances account for f32 reduction-order differences compounding
+    # over 6 iterations)
+    np.testing.assert_allclose(np.asarray(algo1.state.xbar[0]),
+                               np.asarray(algo8.state.xbar[0]),
+                               rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(float(algo1.state.conv),
+                               float(algo8.state.conv),
+                               rtol=1e-2, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(algo1.state.W),
+                               np.asarray(algo8.state.W),
+                               rtol=1e-2, atol=1e-1)
+
+
+def test_sharded_step_emits_collectives():
+    """The compiled PH step over a sharded batch must contain cross-device
+    reduction collectives — this test fails if the xbar reduction stops
+    being a psum (VERDICT r1 item 4)."""
+    b = build_batch(16)
+    m8 = mesh_mod.make_mesh(8)
+    b8 = mesh_mod.shard_batch(b, m8)
+    opts = ph_mod.PHOptions(subproblem_windows=2)
+    st, _ = ph_mod.ph_iter0(b8, jnp.ones(b8.num_nonants, b8.qp.c.dtype),
+                            opts)
+    lowered = ph_mod.ph_iterk.lower(b8, st, opts)
+    hlo = lowered.compile().as_text()
+    assert "all-reduce" in hlo or "all-gather" in hlo, \
+        "no cross-device collective in compiled PH step"
+
+
+def test_pad_then_shard():
+    b = build_batch(6)  # not divisible by 8
+    with pytest.raises(ValueError):
+        mesh_mod.shard_batch(b, mesh_mod.make_mesh(8))
+    pb = batch_mod.pad_to_multiple(b, 8)
+    b8 = mesh_mod.shard_batch(pb, mesh_mod.make_mesh(8))
+    opts = ph_mod.PHOptions(max_iterations=3, conv_thresh=0.0,
+                            subproblem_windows=3)
+    algo = ph_mod.PH(opts, b8)
+    algo.Iter0()
+    algo.state = ph_mod.ph_iterk(b8, algo.state, opts)
+    assert np.isfinite(float(algo.state.conv))
+    # padded scenarios must not influence xbar: recompute from real rows
+    x_non = np.asarray(pb.nonants(algo.state.solver.x))[:6]
+    manual = x_non.mean(axis=0)
+    np.testing.assert_allclose(np.asarray(algo.state.xbar[0]), manual,
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,102 @@
+# Scenario tree + batch compiler unit tests
+# (ref:mpisppy/utils/sputils.py:691-856 tree semantics; spbase.py nonant maps).
+import numpy as np
+import jax.numpy as jnp
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.core.tree import ScenarioTree, two_stage_tree
+from mpisppy_tpu.models import farmer
+
+
+def test_two_stage_tree():
+    t = two_stage_tree(5, 3)
+    assert t.num_stages == 2
+    assert t.num_scenarios == 5
+    assert t.num_nodes == 1
+    assert t.all_nodenames() == ["ROOT"]
+    nos = t.node_of_slot()
+    assert nos.shape == (5, 3)
+    assert (nos == 0).all()
+
+
+def test_three_stage_tree():
+    # branching 2 then 3: 6 scenarios; nodes: ROOT + ROOT_0, ROOT_1
+    t = ScenarioTree(branching_factors=(2, 3), nonants_per_stage=(2, 1))
+    assert t.num_scenarios == 6
+    assert t.nodes_per_stage == (1, 2)
+    assert t.num_nodes == 3
+    assert t.all_nodenames() == ["ROOT", "ROOT_0", "ROOT_1"]
+    nos = t.node_of_slot()
+    assert nos.shape == (6, 3)
+    # stage-1 slots (first two) always ROOT
+    assert (nos[:, :2] == 0).all()
+    # stage-2 slot: scenarios 0-2 -> ROOT_0 (id 1), 3-5 -> ROOT_1 (id 2)
+    np.testing.assert_array_equal(nos[:, 2], [1, 1, 1, 2, 2, 2])
+    assert (t.slot_stage == [1, 1, 2]).all()
+
+
+def test_farmer_batch_build():
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    b = batch_mod.from_specs(specs)
+    assert b.num_scenarios == 3
+    assert b.num_nonants == 3
+    np.testing.assert_allclose(np.asarray(b.p), np.full(3, 1 / 3), rtol=1e-6)
+    # yields differ by scenario -> A batched
+    assert b.qp.A.ndim == 3
+
+
+def test_node_average_two_stage():
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    b = batch_mod.from_specs(specs)
+    vals = jnp.asarray(np.arange(9, dtype=np.float32).reshape(3, 3))
+    avg_s, avg_n = b.node_average(vals)
+    np.testing.assert_allclose(np.asarray(avg_n[0]), [3.0, 4.0, 5.0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(avg_s), np.tile([3, 4, 5], (3, 1)),
+                               rtol=1e-5)
+
+
+def test_node_average_multistage_segments():
+    # 3-stage, branching (2, 2): 4 scenarios, stage-1 slot + stage-2 slot.
+    t = ScenarioTree(branching_factors=(2, 2), nonants_per_stage=(1, 1))
+    nos = t.node_of_slot()
+    np.testing.assert_array_equal(nos[:, 0], [0, 0, 0, 0])
+    np.testing.assert_array_equal(nos[:, 1], [1, 1, 2, 2])
+    # fabricate a tiny batch just to exercise node_average
+    rng = np.random.default_rng(0)
+    specs = []
+    for s in range(4):
+        specs.append(batch_mod.ScenarioSpec(
+            name=f"s{s}", c=rng.normal(size=3), A=np.eye(3),
+            bl=np.full(3, -np.inf), bu=np.ones(3) * 10,
+            l=np.zeros(3), u=np.ones(3) * 5,
+            nonant_idx=np.array([0, 1], np.int32)))
+    b = batch_mod.from_specs(specs, tree=t)
+    vals = jnp.asarray(np.array([[1., 10.], [3., 20.], [5., 30.], [7., 40.]],
+                                np.float32))
+    avg_s, avg_n = b.node_average(vals)
+    # ROOT slot 0: mean of all four = 4; ROOT_0 slot 1: mean(10,20)=15;
+    # ROOT_1 slot 1: mean(30,40)=35
+    assert np.asarray(avg_n)[0, 0] == 4.0
+    assert np.asarray(avg_n)[1, 1] == 15.0
+    assert np.asarray(avg_n)[2, 1] == 35.0
+    np.testing.assert_allclose(np.asarray(avg_s)[:, 1], [15, 15, 35, 35])
+
+
+def test_pad_to_multiple():
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    b = batch_mod.from_specs(specs)
+    pb = batch_mod.pad_to_multiple(b, 8)
+    assert pb.num_scenarios == 8
+    assert pb.num_real == 3
+    np.testing.assert_allclose(float(jnp.sum(pb.p)), 1.0, rtol=1e-6)
+    # padded rows duplicate the last scenario's data
+    np.testing.assert_array_equal(np.asarray(pb.qp.c[-1]),
+                                  np.asarray(b.qp.c[-1]))
+    # p-weighted reductions unchanged
+    vals = pb.nonants(jnp.zeros_like(pb.qp.c) + 1.0)
+    avg_s, _ = pb.node_average(vals)
+    assert np.isfinite(np.asarray(avg_s)).all()
