@@ -1,0 +1,143 @@
+###############################################################################
+# Lagrangian outer bounds from the scenario batch.
+#
+# The reference computes outer (lower, for min) bounds in separate spoke
+# processes that re-solve every scenario with the hub's W fixed in the
+# objective and no prox term, then Allreduce the expectation
+# (ref:mpisppy/cylinders/lagrangian_bounder.py:11-51,
+# ref:mpisppy/cylinders/subgradient_bounder.py:12-54).  TPU-native, the
+# "spoke" is just another batched solve over the SAME HBM-resident
+# scenario tensors:
+#
+#     L(W) = E_s [ min_x  f_s(x) + W_s . x_non ]   with  E_node[W] = 0
+#
+# is one `solve` call on a qp whose c has W added on nonant slots.  The
+# bound is certified from the DUAL side: each subproblem's Fenchel dual
+# value at its final iterates is the bound contribution, and scenarios
+# whose dual residual has not cleared tolerance are flagged so the caller
+# can treat the bound as heuristic rather than certified
+# (the analog of the reference trusting Gurobi's bestbound,
+# ref:mpisppy/spopt.py:413-436 Ebound over outer bounds).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["bound", "per_scenario", "dual_resid", "certified", "solver"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class LagrangianResult:
+    bound: Array        # () E_s[dual value + W·x handled inside]
+    per_scenario: Array  # (S,) per-scenario dual values
+    dual_resid: Array   # (S,) relative dual residuals at exit
+    certified: Array    # () bool: all real scenarios cleared tolerance
+    solver: pdhg.PDHGState
+
+
+def _lagrangian_qp(batch: ScenarioBatch, W: Array) -> boxqp.BoxQP:
+    """Scenario objectives + W·x_non (no prox) —
+    ref:mpisppy/cylinders/lagrangian_bounder.py:13-19 (PH_Prep with
+    attach_prox=False, W reenabled)."""
+    zeros = jnp.zeros_like(W)
+    return batch.with_nonant_linear_quad(W, zeros)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def lagrangian_bound(batch: ScenarioBatch, W: Array,
+                     opts: pdhg.PDHGOptions = pdhg.PDHGOptions(),
+                     solver: pdhg.PDHGState | None = None) -> LagrangianResult:
+    """One Lagrangian bound evaluation L(W); valid outer bound when the
+    per-node probability-weighted mean of W is ~0 (PH invariant,
+    ref:mpisppy/phbase.py:114-179 Compute_Wbar check)."""
+    qp = _lagrangian_qp(batch, W)
+    if solver is None:
+        st = pdhg.init_state(qp, opts)
+    else:
+        st = solver
+    st = pdhg.solve(qp, opts, st)
+    # Dual value of each subproblem (contains the W·x term implicitly:
+    # the qp objective IS f_s + W·x_non in scaled space).
+    dual = boxqp.dual_objective(qp, st.x, st.y)
+    _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    tol = jnp.maximum(opts.tol, 5.0 * jnp.finfo(st.x.dtype).eps)
+    bound = batch.expectation(dual)
+    real = batch.p > 0.0
+    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    return LagrangianResult(bound=bound, per_scenario=dual, dual_resid=rd,
+                            certified=certified, solver=st)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["W", "xbar", "solver", "bound", "best_bound", "certified"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class SubgradientState:
+    W: Array
+    xbar: Array
+    solver: pdhg.PDHGState
+    bound: Array
+    best_bound: Array   # max over CERTIFIED bounds only
+    certified: Array    # () bool: last bound's dual residuals cleared tol
+
+
+@partial(jax.jit, static_argnames=("opts", "n_windows"))
+def subgradient_step(batch: ScenarioBatch, st: SubgradientState, rho: Array,
+                     opts: pdhg.PDHGOptions, n_windows: int = 8
+                     ) -> SubgradientState:
+    """One subgradient iteration: solve with current W (no prox), take the
+    nonanticipativity subgradient W += rho*(x - xbar), record the bound
+    (ref:mpisppy/cylinders/subgradient_bounder.py:12-54 =
+    Compute_Xbar + Update_W + lagrangian bound, fused).
+
+    A truncated (fixed-budget) solve can leave the dual iterate
+    infeasible, in which case dual_objective OVERESTIMATES L(W) — such
+    bounds are not valid and must not enter best_bound; they are gated by
+    the same dual-residual certificate as lagrangian_bound."""
+    qp = _lagrangian_qp(batch, st.W)
+    solver = pdhg.solve_fixed(qp, n_windows, opts, st.solver)
+    dual = boxqp.dual_objective(qp, solver.x, solver.y)
+    _, rd, _ = boxqp.kkt_residuals(qp, solver.x, solver.y)
+    tol = jnp.maximum(opts.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
+    real = batch.p > 0.0
+    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    bound = batch.expectation(dual)
+    x_non = batch.nonants(solver.x)
+    xbar, _ = batch.node_average(x_non)
+    W = st.W + rho * (x_non - xbar)
+    best = jnp.where(certified, jnp.maximum(st.best_bound, bound),
+                     st.best_bound)
+    return SubgradientState(W=W, xbar=xbar, solver=solver, bound=bound,
+                            best_bound=best, certified=certified)
+
+
+def subgradient_init(batch: ScenarioBatch,
+                     opts: pdhg.PDHGOptions = pdhg.PDHGOptions(),
+                     W: Array | None = None) -> SubgradientState:
+    S, N = batch.num_scenarios, batch.num_nonants
+    dt = batch.qp.c.dtype
+    if W is None:
+        W = jnp.zeros((S, N), dt)
+    qp = _lagrangian_qp(batch, W)
+    return SubgradientState(
+        W=W,
+        xbar=jnp.zeros((S, N), dt),
+        solver=pdhg.init_state(qp, opts),
+        bound=jnp.asarray(-jnp.inf, dt),
+        best_bound=jnp.asarray(-jnp.inf, dt),
+        certified=jnp.asarray(False),
+    )

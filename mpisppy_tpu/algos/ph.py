@@ -53,13 +53,15 @@ class PHOptions:
     smoothed: bool = False             # ref 'smoothed' / Update_z
     smooth_beta: float = 0.2           # ref 'defaultPHbeta'
     smooth_p: float = 0.0              # ref 'defaultPHp' (coef of (x-z)^2/2)
+    compute_xsqbar: bool = False       # node avg of x^2 (fixer variance test)
     display_progress: bool = False
     time_limit: float | None = None
 
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["solver", "W", "z", "xbar", "xsqbar", "conv", "rho"],
+    data_fields=["solver", "W", "z", "xbar", "xbar_nodes", "xsqbar", "conv",
+                 "rho"],
     meta_fields=[],
 )
 @dataclasses.dataclass(frozen=True)
@@ -68,13 +70,14 @@ class PHState:
     W: Array                # (S, N) duals, original space
     z: Array                # (S, N) smoothing state (unused unless smoothed)
     xbar: Array             # (S, N) per-scenario view of node averages
-    xsqbar: Array           # (S, N) node averages of x^2 (for fixers)
+    xbar_nodes: Array       # (num_nodes, N) node averages
+    xsqbar: Array           # (S, N) node avg of x^2 (zeros unless enabled)
     conv: Array             # () scaled ||x - xbar||_1
     rho: Array              # (N,) per-slot penalty
 
 
 def _xbar_w_conv(batch: ScenarioBatch, st: PHState, beta: float,
-                 smoothed: bool):
+                 smoothed: bool, compute_xsqbar: bool):
     """Compute_Xbar + Update_W (+Update_z) + convergence_diff, fused.
 
     Semantics match ref:mpisppy/phbase.py:301-371: W += rho*(x - xbar)
@@ -83,15 +86,21 @@ def _xbar_w_conv(batch: ScenarioBatch, st: PHState, beta: float,
     probability-weighted mean of ||x - xbar||_1 per slot — identical to
     the reference's unweighted mean for uniform probabilities, and the
     correct generalization otherwise (padded p=0 scenarios drop out).
+    xsqbar (the fixer variance statistic, ref:phbase.py:60-66) costs an
+    extra segmented reduction, so it is only computed when an extension
+    asks for it (compute_xsqbar).
     """
     x_non = batch.nonants(st.solver.x)
-    xbar, _ = batch.node_average(x_non)
-    xsqbar, _ = batch.node_average(x_non * x_non)
+    xbar, xbar_nodes = batch.node_average(x_non)
+    if compute_xsqbar:
+        xsqbar, _ = batch.node_average(x_non * x_non)
+    else:
+        xsqbar = st.xsqbar
     W = st.W + st.rho * (x_non - xbar)
     z = (1.0 - beta) * st.z + beta * x_non if smoothed else st.z
     conv = batch.expectation(
         jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / batch.num_nonants
-    return x_non, xbar, xsqbar, W, z, conv
+    return x_non, xbar, xbar_nodes, xsqbar, W, z, conv
 
 
 def _prox_qp(batch: ScenarioBatch, W: Array, xbar: Array, z: Array,
@@ -113,12 +122,15 @@ def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
     trivial_bound = batch.expectation(obj)
     zeros = jnp.zeros((batch.num_scenarios, batch.num_nonants),
                       batch.qp.c.dtype)
-    st = PHState(solver=solver, W=zeros, z=zeros, xbar=zeros, xsqbar=zeros,
+    zeros_nodes = jnp.zeros((batch.tree.num_nodes, batch.num_nonants),
+                            batch.qp.c.dtype)
+    st = PHState(solver=solver, W=zeros, z=zeros, xbar=zeros,
+                 xbar_nodes=zeros_nodes, xsqbar=zeros,
                  conv=jnp.asarray(jnp.inf, batch.qp.c.dtype), rho=rho)
-    x_non, xbar, xsqbar, W, z, conv = _xbar_w_conv(
-        batch, st, opts.smooth_beta, False)
-    return dataclasses.replace(st, W=W, xbar=xbar, xsqbar=xsqbar,
-                               conv=conv), trivial_bound
+    x_non, xbar, xbar_nodes, xsqbar, W, z, conv = _xbar_w_conv(
+        batch, st, opts.smooth_beta, False, opts.compute_xsqbar)
+    return dataclasses.replace(st, W=W, xbar=xbar, xbar_nodes=xbar_nodes,
+                               xsqbar=xsqbar, conv=conv), trivial_bound
 
 
 @partial(jax.jit, static_argnames=("opts",))
@@ -132,9 +144,10 @@ def ph_iterk(batch: ScenarioBatch, st: PHState, opts: PHOptions) -> PHState:
     solver = pdhg.solve_fixed(qp_eff, opts.subproblem_windows, opts.pdhg,
                               st.solver)
     st = dataclasses.replace(st, solver=solver)
-    x_non, xbar, xsqbar, W, z, conv = _xbar_w_conv(
-        batch, st, opts.smooth_beta, opts.smoothed)
-    return dataclasses.replace(st, W=W, z=z, xbar=xbar, xsqbar=xsqbar,
+    x_non, xbar, xbar_nodes, xsqbar, W, z, conv = _xbar_w_conv(
+        batch, st, opts.smooth_beta, opts.smoothed, opts.compute_xsqbar)
+    return dataclasses.replace(st, W=W, z=z, xbar=xbar,
+                               xbar_nodes=xbar_nodes, xsqbar=xsqbar,
                                conv=conv)
 
 
@@ -211,14 +224,16 @@ class PH:
                 self.spcomm.sync()
             global_toc(f"PH iter {k}: conv = {conv:.3e}",
                        self.options.display_progress)
-            if conv <= self.options.conv_thresh:
-                global_toc(f"PH converged at iter {k} (conv={conv:.3e})",
-                           self.options.display_progress)
+            # The hub object takes precedence over the local convergence
+            # metric (ref:mpisppy/phbase.py:996-1015 ordering).
+            if self.spcomm is not None and self.spcomm.is_converged():
                 break
             if (self.converger_object is not None
                     and self.converger_object.is_converged()):
                 break
-            if self.spcomm is not None and self.spcomm.is_converged():
+            if conv <= self.options.conv_thresh:
+                global_toc(f"PH converged at iter {k} (conv={conv:.3e})",
+                           self.options.display_progress)
                 break
             if (self.options.time_limit is not None
                     and time.time() - t0 > self.options.time_limit):
@@ -239,9 +254,7 @@ class PH:
     # -- solution access (ref:spbase.py:561-672 analogs) -----------------
     def nonant_values(self) -> np.ndarray:
         """(num_nodes, N) converged per-node nonant values (xbar)."""
-        _, nodes = self.batch.node_average(
-            self.batch.nonants(self.state.solver.x))
-        return np.asarray(nodes)
+        return np.asarray(self.state.xbar_nodes)
 
     def first_stage_solution(self) -> np.ndarray:
         """(n_root_slots,) root-node nonant values."""

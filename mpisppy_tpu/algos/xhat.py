@@ -1,0 +1,149 @@
+###############################################################################
+# Xhat evaluation and inner-bound heuristics.
+#
+# The reference's Xhat_Eval (ref:mpisppy/utils/xhat_eval.py:33-400) fixes
+# candidate first-stage values into every scenario model and solves for
+# the recourse, giving E[f(xhat, xi_s)] — an upper (inner) bound for min
+# problems.  Its xhat spokes try candidates: xbar (rounded for integers,
+# ref:mpisppy/extensions/xhatxbar.py + cylinders/xhatxbar_bounder.py:37),
+# individual scenarios' own first-stage values shuffled
+# (ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:23-157), and
+# slamming every nonant to the scenario-max/min
+# (ref:mpisppy/cylinders/slam_heuristic.py:25-129).
+#
+# TPU-native, a candidate evaluation is one batched solve of the SAME
+# scenario tensors with the nonant box collapsed to the candidate point,
+# and K candidates batch again on a leading axis via vmap — the whole
+# "shuffle looper" is a single (K, S)-shaped program, not a process.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["value", "per_scenario", "feasible", "primal_resid"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class XhatResult:
+    value: Array         # () E[f(xhat)]; +inf when infeasible
+    per_scenario: Array  # (S,) recourse objective values
+    feasible: Array      # () bool — every real scenario feasible at tol
+    primal_resid: Array  # (S,) relative primal residuals
+
+
+@partial(jax.jit, static_argnames=("opts", "feas_tol"))
+def evaluate(batch: ScenarioBatch, xhat: Array,
+             opts: pdhg.PDHGOptions = pdhg.PDHGOptions(),
+             feas_tol: float = 1e-3) -> XhatResult:
+    """E[f(xhat, xi_s)] with nonants fixed to `xhat` ((N,) root-only or
+    (num_nodes, N) per-node) — ref:mpisppy/utils/xhat_eval.py:254-340
+    (evaluate = _fix_nonants + solve_loop + Eobjective).
+    Infeasibility (recourse cannot satisfy constraints) is detected from
+    the relative primal residual exceeding `feas_tol` (a genuinely
+    infeasible candidate leaves O(1) residual; a converged-but-for-f32
+    solve leaves ~1e-4) and poisons only the scalar `value`, not the
+    per-scenario vector."""
+    qp = batch.with_fixed_nonants(xhat)
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    # Original-space objective: scaled c,q absorb the column scaling.
+    obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
+    rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    real = batch.p > 0.0
+    feas = jnp.all(jnp.where(real, rp <= feas_tol, True))
+    value = jnp.where(feas, batch.expectation(obj),
+                      jnp.asarray(jnp.inf, obj.dtype))
+    return XhatResult(value=value, per_scenario=obj, feasible=feas,
+                      primal_resid=rp)
+
+
+def round_integers(batch: ScenarioBatch, xhat: Array) -> Array:
+    """Round integer nonant slots (ref:mpisppy/extensions/xhatxbar.py's
+    rounding of xbar for integer variables)."""
+    return jnp.where(batch.integer_slot, jnp.round(xhat), xhat)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def xhat_xbar(batch: ScenarioBatch, xbar_nodes: Array,
+              opts: pdhg.PDHGOptions = pdhg.PDHGOptions()) -> XhatResult:
+    """Try x̂ = x̄ (integers rounded) — the XhatXbar inner bound
+    (ref:mpisppy/cylinders/xhatxbar_bounder.py:37)."""
+    return evaluate(batch, round_integers(batch, xbar_nodes), opts)
+
+
+@partial(jax.jit, static_argnames=("opts", "k"))
+def xhat_shuffle(batch: ScenarioBatch, x_non: Array, scen_ids: Array,
+                 k: int, opts: pdhg.PDHGOptions = pdhg.PDHGOptions()):
+    """Try k candidate scenarios' own nonant vectors as x̂, all at once.
+
+    x_non: (S, N) current per-scenario nonants; scen_ids: (k,) candidate
+    indices (host supplies the deterministic shuffle, seed 42, matching
+    ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:61-99).  Returns
+    (values (k,), feasible (k,)) — the host picks the best.
+    The reference tries candidates one at a time across ranks; here the
+    K trials batch into one (k*S)-subproblem program.
+    """
+    cands = round_integers(batch, x_non[scen_ids])  # (k, N)
+
+    def one(xhat):
+        r = evaluate(batch, xhat, opts)
+        return r.value, r.feasible
+
+    values, feas = jax.vmap(one)(cands)
+    return values, feas
+
+
+def slam_candidate(batch: ScenarioBatch, x_non: Array,
+                   sense_max: bool) -> Array:
+    """(N,) candidate from slamming each nonant to its across-scenario
+    max (ceil for integers) or min (floor) — device computation."""
+    big = jnp.asarray(jnp.inf, x_non.dtype)
+    mask = (batch.p > 0.0)[:, None]
+    if sense_max:
+        xhat = jnp.max(jnp.where(mask, x_non, -big), axis=0)
+        return jnp.where(batch.integer_slot, jnp.ceil(xhat), xhat)
+    xhat = jnp.min(jnp.where(mask, x_non, big), axis=0)
+    return jnp.where(batch.integer_slot, jnp.floor(xhat), xhat)
+
+
+@partial(jax.jit, static_argnames=("opts", "sense_max"))
+def slam_heuristic(batch: ScenarioBatch, x_non: Array, sense_max: bool,
+                   opts: pdhg.PDHGOptions = pdhg.PDHGOptions()) -> XhatResult:
+    """Slam every nonant to its across-scenario max (or min) and evaluate
+    (ref:mpisppy/cylinders/slam_heuristic.py:25-129)."""
+    return evaluate(batch, slam_candidate(batch, x_non, sense_max), opts)
+
+
+class XhatEval:
+    """Host-side evaluator with the reference Xhat_Eval surface
+    (ref:mpisppy/utils/xhat_eval.py:33): evaluate(nonant_cache),
+    evaluate_one, calculate_incumbent."""
+
+    def __init__(self, batch: ScenarioBatch,
+                 opts: pdhg.PDHGOptions = pdhg.PDHGOptions()):
+        self.batch = batch
+        self.opts = opts
+
+    def evaluate_one(self, xhat) -> float:
+        return float(evaluate(self.batch, jnp.asarray(xhat), self.opts).value)
+
+    def evaluate(self, xhat) -> float:
+        return self.evaluate_one(xhat)
+
+    def calculate_incumbent(self, candidates) -> tuple[float, int]:
+        """Best (value, index) over a list of candidates
+        (ref:mpisppy/utils/xhat_eval.py:368)."""
+        vals = [self.evaluate_one(x) for x in candidates]
+        best = int(min(range(len(vals)), key=lambda i: vals[i]))
+        return vals[best], best

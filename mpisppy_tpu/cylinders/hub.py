@@ -1,0 +1,192 @@
+###############################################################################
+# Hub: runs the hub algorithm (PH), feeds spokes, tracks bounds, decides
+# termination (ref:mpisppy/cylinders/hub.py:28-724).
+#
+# The reference hub Puts W/nonants into RMA windows and Gets bounds back,
+# with write-id consensus; here `sync()` hands the spokes a host-side
+# snapshot dict (device arrays — zero-copy) and harvests their previous
+# results.  Spokes launch device work asynchronously, so the PH hot loop
+# and the spoke solves pipeline on the device queue exactly like the
+# reference's concurrent cylinders — minus every lock and window.
+#
+# Termination semantics match ref:mpisppy/cylinders/hub.py:82-166:
+#   * rel_gap  <= options['rel_gap']   (gap = (inner-outer)/|inner|)
+#   * abs_gap  <= options['abs_gap']
+#   * inner bounds stalled for 'max_stalled_iters' hub iterations
+###############################################################################
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator
+from mpisppy_tpu.cylinders.spoke import ConvergerSpokeType
+
+
+class Hub(SPCommunicator):
+    """Bound bookkeeping + termination (ref:cylinders/hub.py:28-243)."""
+
+    def __init__(self, opt, options: dict | None = None, spokes=None):
+        super().__init__(opt, options)
+        self.spokes = spokes or []
+        self.BestOuterBound = -math.inf  # min problems: lower bound
+        self.BestInnerBound = math.inf
+        self.latest_ib_char = ""
+        self.latest_ob_char = ""
+        self._inner_bound_update_iter = 0
+        self._iter = 0
+        self.trace: list[dict] = []
+
+    # -- bound bookkeeping (ref:hub.py:207-243) ---------------------------
+    def OuterBoundUpdate(self, new_bound: float, char: str = "*"):
+        if new_bound > self.BestOuterBound:
+            self.BestOuterBound = new_bound
+            self.latest_ob_char = char
+        return self.BestOuterBound
+
+    def InnerBoundUpdate(self, new_bound: float, char: str = "*"):
+        if new_bound < self.BestInnerBound:
+            self.BestInnerBound = new_bound
+            self.latest_ib_char = char
+            self._inner_bound_update_iter = self._iter
+        return self.BestInnerBound
+
+    # -- gaps + termination (ref:hub.py:82-166) ---------------------------
+    def compute_gaps(self) -> tuple[float, float]:
+        abs_gap = self.BestInnerBound - self.BestOuterBound
+        nano = 1e-10
+        if self.BestInnerBound in (math.inf, -math.inf):
+            rel_gap = math.inf
+        else:
+            rel_gap = abs_gap / max(nano, abs(self.BestInnerBound))
+        return abs_gap, rel_gap
+
+    def determine_termination(self) -> bool:
+        abs_gap, rel_gap = self.compute_gaps()
+        opt = self.options
+        if "rel_gap" in opt and rel_gap <= opt["rel_gap"]:
+            global_toc(f"Terminating: rel_gap {rel_gap:.4e} <= "
+                       f"{opt['rel_gap']}", True)
+            return True
+        if "abs_gap" in opt and abs_gap <= opt["abs_gap"]:
+            global_toc(f"Terminating: abs_gap {abs_gap:.4e} <= "
+                       f"{opt['abs_gap']}", True)
+            return True
+        if "max_stalled_iters" in opt and (
+                self._iter - self._inner_bound_update_iter
+                >= opt["max_stalled_iters"]
+                and self.BestInnerBound < math.inf):
+            global_toc("Terminating: inner bound stalled", True)
+            return True
+        return False
+
+    def is_converged(self) -> bool:
+        return self.determine_termination()
+
+
+class PHHub(Hub):
+    """PH as the hub algorithm (ref:cylinders/hub.py:462-573).
+
+    `opt` is an algos.ph.PH driver; the hub installs itself as
+    `opt.spcomm` so the PH loop calls sync()/is_converged() each
+    iteration (the cylinder seam, ref:phbase.py:1040-1056).
+    """
+
+    def setup_hub(self):
+        self.opt.spcomm = self
+        for sp in self.spokes:
+            sp.make_windows()
+
+    def _snapshot(self) -> dict:
+        """Device-array snapshot for spokes (ref:hub.py:517-532 sends
+        Ws + nonants + bounds).  xbar views are reused from the PH state
+        — ph_iterk already reduced them."""
+        st = self.opt.state
+        batch = self.opt.batch
+        return {
+            "W": st.W,
+            "nonants": batch.nonants(st.solver.x),
+            "xbar_scen": st.xbar,
+            "xbar_nodes": st.xbar_nodes,
+            "iter": self._iter,
+            "bounds": (self.BestOuterBound, self.BestInnerBound),
+        }
+
+    def _harvest_all(self):
+        """Fold every spoke's latest result into the bound bookkeeping."""
+        for sp in self.spokes:
+            b = sp.harvest()
+            if b is None:
+                continue
+            ch = type(sp).__name__[0]
+            if ConvergerSpokeType.OUTER_BOUND in sp.converger_spoke_types:
+                self.OuterBoundUpdate(b, ch)
+            elif ConvergerSpokeType.INNER_BOUND in sp.converger_spoke_types:
+                self.InnerBoundUpdate(b, ch)
+            sp.trace.append((self._iter, b))
+
+    def sync(self):
+        """One hub<->spoke exchange: harvest the spokes' previous async
+        results, then launch their next round on a fresh snapshot."""
+        self._iter += 1
+        self._harvest_all()
+        payload = self._snapshot()
+        self.from_hub.put(payload)  # for API parity / inspection
+        for sp in self.spokes:
+            sp.update(payload)
+        abs_gap, rel_gap = self.compute_gaps()
+        self.trace.append({
+            "iter": self._iter, "conv": float(self.opt.state.conv),
+            "outer": self.BestOuterBound, "inner": self.BestInnerBound,
+            "abs_gap": abs_gap, "rel_gap": rel_gap,
+            "ob_char": self.latest_ob_char, "ib_char": self.latest_ib_char,
+        })
+        if self.options.get("display_progress"):
+            global_toc(
+                f"iter {self._iter:4d} conv {float(self.opt.state.conv):9.3e}"
+                f" outer {self.BestOuterBound:12.5g}"
+                f" inner {self.BestInnerBound:12.5g} rel_gap {rel_gap:8.3e}"
+                f" ({self.latest_ob_char}/{self.latest_ib_char})", True)
+
+    def is_converged(self) -> bool:
+        # use the PH trivial bound as the initial outer bound (ref:hub.py:544)
+        if self.opt.trivial_bound is not None and self._iter <= 1:
+            self.OuterBoundUpdate(self.opt.trivial_bound, "T")
+        return self.determine_termination()
+
+    def main(self):
+        """ref:cylinders/hub.py:571-573."""
+        return self.opt.ph_main()
+
+    def finalize(self):
+        # one last harvest so late async results count
+        self._harvest_all()
+        return self.BestInnerBound
+
+    def hub_finalize(self):
+        abs_gap, rel_gap = self.compute_gaps()
+        global_toc(f"Final bounds: outer {self.BestOuterBound:.6g} "
+                   f"inner {self.BestInnerBound:.6g} rel_gap {rel_gap:.3e}",
+                   self.options.get("display_progress", False))
+
+    # -- solution access --------------------------------------------------
+    def best_nonants(self) -> np.ndarray:
+        """(num_nodes, N) nonants of the solution that achieved
+        BestInnerBound — the inner-bound winner's cached x̂
+        (ref:spin_the_wheel.py:171-195 _determine_innerbound_winner);
+        falls back to the final xbar when no incumbent exists."""
+        winner, best = None, math.inf
+        for sp in self.spokes:
+            if (ConvergerSpokeType.INNER_BOUND in sp.converger_spoke_types
+                    and sp.bound is not None and sp.bound < best
+                    and getattr(sp, "best_xhat", None) is not None):
+                winner, best = sp, sp.bound
+        if winner is not None:
+            xhat = np.asarray(winner.best_xhat)
+            if xhat.ndim == 1:
+                num_nodes = self.opt.batch.tree.num_nodes
+                return np.broadcast_to(xhat, (num_nodes, xhat.shape[0]))
+            return xhat
+        return np.asarray(self.opt.state.xbar_nodes)

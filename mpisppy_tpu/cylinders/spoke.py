@@ -1,0 +1,260 @@
+###############################################################################
+# Spoke taxonomy (ref:mpisppy/cylinders/spoke.py:21-380) and the concrete
+# bound spokes, TPU-native.
+#
+# A spoke consumes the hub's latest (W, nonants, xbar) snapshot and
+# produces a bound.  In the reference each spoke is an MPI cylinder
+# re-solving its own copy of every scenario with a CPU solver; here each
+# spoke is a *batched device computation over the same HBM-resident
+# ScenarioBatch*, launched without blocking (XLA async dispatch) so hub
+# iterations overlap spoke solves — the TPU answer to the reference's
+# asynchronous cylinders.  The hub reads `bound` later, blocking only on
+# the scalar.
+#
+# Spoke map (ref file -> class here):
+#   lagrangian_bounder.py:53-98  -> LagrangianOuterBound  (consumes W)
+#   lagranger_bounder.py:18+     -> LagrangerOuterBound   (consumes x, own W)
+#   subgradient_bounder.py:12-54 -> SubgradientOuterBound (self-contained)
+#   xhatxbar_bounder.py:37       -> XhatXbarInnerBound
+#   xhatshufflelooper_bounder.py -> XhatShuffleInnerBound
+#   slam_heuristic.py:25-129     -> SlamMaxHeuristic/SlamMinHeuristic
+###############################################################################
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.algos import lagrangian as lag_mod
+from mpisppy_tpu.algos import xhat as xhat_mod
+from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator
+from mpisppy_tpu.ops import pdhg
+
+
+class ConvergerSpokeType(enum.Enum):
+    """ref:mpisppy/cylinders/spoke.py:21-25."""
+
+    OUTER_BOUND = 1
+    INNER_BOUND = 2
+    W_GETTER = 3
+    NONANT_GETTER = 4
+
+
+class Spoke(SPCommunicator):
+    """Base spoke: runs against the hub's ScenarioBatch snapshot."""
+
+    converger_spoke_types: tuple[ConvergerSpokeType, ...] = ()
+
+    def __init__(self, opt, options: dict | None = None):
+        super().__init__(opt, options)
+        self.batch = opt.batch
+        self.pdhg_opts = self.options.get(
+            "pdhg_opts", pdhg.PDHGOptions(tol=1e-6))
+        self.bound: float | None = None
+        self._pending = None  # un-read device results (async dispatch)
+        self.trace: list[tuple[int, float]] = []  # (hub_iter, bound)
+
+    def update(self, hub_payload: dict):
+        """Launch this spoke's computation for the hub snapshot.  Must
+        not block on device results."""
+        raise NotImplementedError
+
+    def harvest(self) -> float | None:
+        """Read the last launched result (blocks on the scalar only),
+        update self.bound, return it."""
+        raise NotImplementedError
+
+    def main(self):  # spokes are driven by the wheel, not self-running
+        pass
+
+
+class OuterBoundSpoke(Spoke):
+    """Outer (lower, for min) bounds — only CERTIFIED results accepted
+    (ref:mpisppy/cylinders/spoke.py:250-275).  Subclasses leave a
+    LagrangianResult-like object (bound, certified) in self._pending."""
+
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,)
+    bound_sense = "outer"
+
+    def harvest(self):
+        if self._pending is None:
+            return None
+        res = self._pending
+        if bool(res.certified):
+            b = float(res.bound)
+            if self.bound is None or b > self.bound:
+                self.bound = b
+        return self.bound
+
+
+class InnerBoundSpoke(Spoke):
+    """Incumbent finders; keeps the best (xhat, value) pair so the
+    winning solution can be written out (ref:mpisppy/cylinders/
+    spoke.py:242-248,325-367 update_if_improving + best cache)."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,)
+    bound_sense = "inner"
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self.best_xhat = None  # (num_nodes, N) or (N,) candidate
+
+    def _offer(self, value: float, xhat) -> None:
+        if self.bound is None or value < self.bound:
+            self.bound = value
+            self.best_xhat = np.asarray(xhat)
+
+    def harvest(self):
+        if self._pending is None:
+            return None
+        res, xhat = self._pending
+        if bool(res.feasible):
+            self._offer(float(res.value), xhat)
+        return self.bound
+
+
+# ---------------------------------------------------------------------------
+# Outer bounds
+# ---------------------------------------------------------------------------
+class LagrangianOuterBound(OuterBoundSpoke):
+    """L(W) at the hub's W (ref:cylinders/lagrangian_bounder.py:53-98)."""
+
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.W_GETTER)
+
+    def update(self, hub_payload):
+        W = hub_payload["W"]
+        self._pending = lag_mod.lagrangian_bound(
+            self.batch, W, self.pdhg_opts,
+            self._pending.solver if self._pending is not None else None)
+
+
+class LagrangerOuterBound(OuterBoundSpoke):
+    """Takes hub *x* and maintains its own W from a rho schedule
+    (ref:cylinders/lagranger_bounder.py:18+).  rho_rescale_factors:
+    {iter: factor} applied multiplicatively when the hub iter passes."""
+
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self.rho = float(self.options.get("rho", 1.0))
+        self.rescale = dict(self.options.get("rho_rescale_factors", {}))
+        self._W = None
+
+    def update(self, hub_payload):
+        x_non = hub_payload["nonants"]
+        xbar = hub_payload["xbar_scen"]
+        it = hub_payload.get("iter", 0)
+        if it in self.rescale:
+            self.rho *= float(self.rescale.pop(it))
+        dW = self.rho * (x_non - xbar)
+        self._W = dW if self._W is None else self._W + dW
+        self._pending = lag_mod.lagrangian_bound(
+            self.batch, self._W, self.pdhg_opts)
+
+
+class SubgradientOuterBound(OuterBoundSpoke):
+    """Self-contained subgradient loop advancing one step per hub sync
+    (ref:cylinders/subgradient_bounder.py:12-54).  best_bound already
+    folds only certified bounds (algos/lagrangian.subgradient_step)."""
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self.rho = jnp.asarray(float(self.options.get("rho", 1.0)),
+                               self.batch.qp.c.dtype)
+        self.n_windows = int(self.options.get("n_windows", 20))
+        self._st = lag_mod.subgradient_init(self.batch, self.pdhg_opts)
+
+    def update(self, hub_payload):
+        self._st = lag_mod.subgradient_step(
+            self.batch, self._st, self.rho, self.pdhg_opts, self.n_windows)
+        self._pending = self._st
+
+    def harvest(self):
+        if self._pending is None:
+            return None
+        b = float(self._pending.best_bound)
+        if np.isfinite(b) and (self.bound is None or b > self.bound):
+            self.bound = b
+        return self.bound
+
+
+# ---------------------------------------------------------------------------
+# Inner bounds (incumbent finders)
+# ---------------------------------------------------------------------------
+class XhatXbarInnerBound(InnerBoundSpoke):
+    """x̂ = rounded x̄ (ref:cylinders/xhatxbar_bounder.py:37)."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+
+    def update(self, hub_payload):
+        xbar_nodes = hub_payload["xbar_nodes"]
+        self._pending = (xhat_mod.xhat_xbar(self.batch, xbar_nodes,
+                                            self.pdhg_opts),
+                         xbar_nodes)
+
+
+class XhatShuffleInnerBound(InnerBoundSpoke):
+    """Deterministic shared shuffle of candidate scenarios, k tried per
+    sync as ONE (k,S)-batched program
+    (ref:cylinders/xhatshufflelooper_bounder.py:23-157; seed 42 at :74)."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self.k = int(self.options.get("k", 4))
+        rng = np.random.default_rng(self.options.get("seed", 42))
+        self._order = rng.permutation(self.batch.num_real)
+        self._cursor = 0
+
+    def _next_ids(self):
+        ids = [int(self._order[(self._cursor + j) % self.batch.num_real])
+               for j in range(self.k)]
+        self._cursor = (self._cursor + self.k) % self.batch.num_real
+        return jnp.asarray(ids)
+
+    def update(self, hub_payload):
+        x_non = hub_payload["nonants"]
+        ids = self._next_ids()
+        cands = xhat_mod.round_integers(self.batch, x_non[ids])
+        self._pending = (xhat_mod.xhat_shuffle(
+            self.batch, x_non, ids, self.k, self.pdhg_opts), cands)
+
+    def harvest(self):
+        if self._pending is None:
+            return None
+        (vals, feas), cands = self._pending
+        vals = np.asarray(vals)
+        feas = np.asarray(feas)
+        if feas.any():
+            j = int(np.argmin(np.where(feas, vals, np.inf)))
+            self._offer(float(vals[j]), np.asarray(cands)[j])
+        return self.bound
+
+
+class _SlamHeuristic(InnerBoundSpoke):
+    sense_max = True
+
+    def update(self, hub_payload):
+        x_non = hub_payload["nonants"]
+        xhat = xhat_mod.slam_candidate(self.batch, x_non, self.sense_max)
+        self._pending = (xhat_mod.evaluate(self.batch, xhat, self.pdhg_opts),
+                         xhat)
+
+
+class SlamMaxHeuristic(_SlamHeuristic):
+    """ref:cylinders/slam_heuristic.py:111."""
+
+    sense_max = True
+
+
+class SlamMinHeuristic(_SlamHeuristic):
+    """ref:cylinders/slam_heuristic.py:121."""
+
+    sense_max = False

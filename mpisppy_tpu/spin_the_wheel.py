@@ -1,0 +1,99 @@
+###############################################################################
+# WheelSpinner: top-level orchestration (ref:mpisppy/spin_the_wheel.py:18-242).
+#
+# The reference splits COMM_WORLD into a (strata x cylinder) process grid
+# and runs one opt object + SPCommunicator per rank
+# (ref:spin_the_wheel.py:224-242 _make_comms).  Here all cylinders drive
+# ONE device mesh from one host process: the hub's PH loop and every
+# spoke's batched solve are enqueued on the same XLA stream, overlapping
+# like the reference's concurrent cylinders, and the scenario axis is the
+# mesh axis.  hub_dict / list_of_spoke_dicts keep the reference's shape:
+#
+#   hub_dict = {"hub_class": PHHub, "hub_kwargs": {"options": {...}},
+#               "opt_class": PH, "opt_kwargs": {...}}
+#   spoke_dict = {"spoke_class": LagrangianOuterBound,
+#                 "opt_kwargs": {"options": {...}}}
+###############################################################################
+from __future__ import annotations
+
+import numpy as np
+
+from mpisppy_tpu import global_toc
+
+
+class WheelSpinner:
+    """ref:mpisppy/spin_the_wheel.py:18."""
+
+    def __init__(self, hub_dict: dict, list_of_spoke_dict=None):
+        self.hub_dict = hub_dict
+        self.list_of_spoke_dict = list_of_spoke_dict or []
+        self.spcomm = None
+        self.opt = None
+        self.on_hub = True  # single-process: we always "are" the hub
+
+    def spin(self, comm_world=None):
+        """Build opt + hub + spokes, run the hub algorithm to
+        completion, terminate + finalize the spokes
+        (ref:spin_the_wheel.py:43-149 run())."""
+        hd = self.hub_dict
+        opt_class = hd["opt_class"]
+        self.opt = opt_class(**hd.get("opt_kwargs", {}))
+
+        spokes = []
+        for sd in self.list_of_spoke_dict:
+            spoke_class = sd["spoke_class"]
+            kw = dict(sd.get("opt_kwargs", {}))
+            spokes.append(spoke_class(self.opt, kw.get("options", kw)))
+
+        hub_class = hd["hub_class"]
+        hub_kwargs = dict(hd.get("hub_kwargs", {}))
+        self.spcomm = hub_class(self.opt, hub_kwargs.get("options", {}),
+                                spokes=spokes)
+        self.spcomm.make_windows()
+        self.spcomm.setup_hub()
+        global_toc("Starting wheel spin", False)
+        self.spcomm.main()
+        self.spcomm.send_terminate()
+        self.spcomm.finalize()
+        self.spcomm.hub_finalize()
+        self.spcomm.free_windows()
+        return self
+
+    # -- results (ref:spin_the_wheel.py:151-222) --------------------------
+    @property
+    def BestInnerBound(self):
+        return self.spcomm.BestInnerBound
+
+    @property
+    def BestOuterBound(self):
+        return self.spcomm.BestOuterBound
+
+    def write_first_stage_solution(self, solution_file_name: str):
+        """npy/csv first-stage (ROOT) solution
+        (ref:spin_the_wheel.py:171-195)."""
+        nodes = self.spcomm.best_nonants()
+        root = nodes[0]
+        stage1 = root[np.nonzero(
+            self.opt.batch.tree.slot_stage == 1)[0]]
+        if solution_file_name.endswith(".npy"):
+            np.save(solution_file_name, stage1)
+        else:
+            with open(solution_file_name, "w") as f:
+                for i, v in enumerate(stage1):
+                    f.write(f"x{i},{v}\n")
+
+    def write_tree_solution(self, directory_name: str):
+        """Per-node nonant values, one file per tree node
+        (ref:spin_the_wheel.py:197-222)."""
+        import os
+        os.makedirs(directory_name, exist_ok=True)
+        nodes = self.spcomm.best_nonants()
+        tree = self.opt.batch.tree
+        for nid in range(tree.num_nodes):
+            name = tree.node_name(nid)
+            stage = int(np.searchsorted(
+                np.cumsum(tree.nodes_per_stage), nid, side="right")) + 1
+            slots = np.nonzero(tree.slot_stage == stage)[0]
+            with open(os.path.join(directory_name, f"{name}.csv"), "w") as f:
+                for i in slots:
+                    f.write(f"slot{i},{nodes[nid, i]}\n")

@@ -1,0 +1,120 @@
+# Bounds plane: Lagrangian outer bound, xhat inner bounds, subgradient.
+# Oracle: farmer 3-scenario EF objective -108390 (scipy-verified in
+# test_farmer_ef_ph.py).  For an LP, outer <= EF obj <= inner, and both
+# tighten to the EF value at the PH fixed point.
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import lagrangian as lag_mod
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.algos import xhat as xhat_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg
+
+FARMER_EF_OBJ = -108390.0
+
+
+@pytest.fixture(scope="module")
+def farmer3():
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    return batch_mod.from_specs(specs)
+
+
+@pytest.fixture(scope="module")
+def ph_solved(farmer3):
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=150,
+                            conv_thresh=5e-2, subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7))
+    algo = ph_mod.PH(opts, farmer3)
+    algo.ph_main()
+    return algo
+
+
+def test_lagrangian_zero_w_is_wait_and_see(farmer3):
+    """L(0) = E[min f_s] (the trivial/wait-and-see bound), below EF obj."""
+    W0 = jnp.zeros((farmer3.num_scenarios, farmer3.num_nonants),
+                   farmer3.qp.c.dtype)
+    res = lag_mod.lagrangian_bound(farmer3, W0,
+                                   pdhg.PDHGOptions(tol=1e-7))
+    assert bool(res.certified)
+    assert float(res.bound) <= FARMER_EF_OBJ + 1.0
+    # wait-and-see for farmer3 is about -115406 (known value)
+    assert float(res.bound) == pytest.approx(-115405.6, rel=1e-3)
+
+
+def test_lagrangian_with_ph_w_tightens(farmer3, ph_solved):
+    """L(W*) with converged PH duals should be close to the EF objective
+    and never above it (valid outer bound)."""
+    res = lag_mod.lagrangian_bound(farmer3, ph_solved.state.W,
+                                   pdhg.PDHGOptions(tol=1e-7))
+    b = float(res.bound)
+    assert b <= FARMER_EF_OBJ + 5.0
+    assert b >= FARMER_EF_OBJ - 0.02 * abs(FARMER_EF_OBJ)
+
+
+def test_xhat_xbar_inner_bound(farmer3, ph_solved):
+    """E[f(xbar)] is a valid upper bound and ~EF obj at the optimum."""
+    _, nodes = farmer3.node_average(
+        farmer3.nonants(ph_solved.state.solver.x))
+    res = xhat_mod.xhat_xbar(farmer3, nodes[0],
+                             pdhg.PDHGOptions(tol=1e-7))
+    assert bool(res.feasible)
+    v = float(res.value)
+    # valid upper bound modulo f32 solve accuracy (~1e-4 relative)
+    assert v >= FARMER_EF_OBJ - 2e-3 * abs(FARMER_EF_OBJ)
+    assert v <= FARMER_EF_OBJ + 0.02 * abs(FARMER_EF_OBJ)
+
+
+def test_gap_closes(farmer3, ph_solved):
+    lag = lag_mod.lagrangian_bound(farmer3, ph_solved.state.W,
+                                   pdhg.PDHGOptions(tol=1e-7))
+    _, nodes = farmer3.node_average(
+        farmer3.nonants(ph_solved.state.solver.x))
+    inner = xhat_mod.xhat_xbar(farmer3, nodes[0],
+                               pdhg.PDHGOptions(tol=1e-7))
+    outer_v, inner_v = float(lag.bound), float(inner.value)
+    assert outer_v <= inner_v + 2e-3 * abs(inner_v)
+    gap = (inner_v - outer_v) / max(1.0, abs(inner_v))
+    assert gap < 0.02
+
+
+def test_xhat_infeasible_candidate(farmer3):
+    """A nonsense candidate (negative acreage impossible: l=0 clamps —
+    use an over-acreage candidate violating the total-land row)."""
+    bad = jnp.full((farmer3.num_nonants,), 400.0)  # sums to 1200 > 500
+    res = xhat_mod.evaluate(farmer3, bad, pdhg.PDHGOptions(tol=1e-6))
+    assert not bool(res.feasible)
+    assert np.isinf(float(res.value))
+
+
+def test_xhat_shuffle(farmer3, ph_solved):
+    x_non = farmer3.nonants(ph_solved.state.solver.x)
+    ids = jnp.asarray([0, 1, 2])
+    vals, feas = xhat_mod.xhat_shuffle(farmer3, x_non, ids, 3,
+                                       pdhg.PDHGOptions(tol=1e-6))
+    assert bool(feas.all())
+    # every candidate evaluation is a valid upper bound (f32 slack)
+    assert float(jnp.min(vals)) >= FARMER_EF_OBJ - 2e-3 * abs(FARMER_EF_OBJ)
+
+
+def test_slam_heuristic(farmer3, ph_solved):
+    x_non = farmer3.nonants(ph_solved.state.solver.x)
+    res = xhat_mod.slam_heuristic(farmer3, x_non, sense_max=False,
+                                  opts=pdhg.PDHGOptions(tol=1e-6))
+    # slam-min of acreage is feasible (land constraint is <=)
+    assert bool(res.feasible)
+    assert float(res.value) >= FARMER_EF_OBJ - 2e-3 * abs(FARMER_EF_OBJ)
+
+
+def test_subgradient_improves(farmer3):
+    opts = pdhg.PDHGOptions(tol=1e-6)
+    st = lag_mod.subgradient_init(farmer3, opts)
+    rho = jnp.asarray(1.0, farmer3.qp.c.dtype)
+    for _ in range(20):
+        st = lag_mod.subgradient_step(farmer3, st, rho, opts, n_windows=40)
+    assert float(st.best_bound) <= FARMER_EF_OBJ + 2e-3 * abs(FARMER_EF_OBJ)
+    # best bound beats L(0) (wait-and-see)
+    assert float(st.best_bound) > -115405.0

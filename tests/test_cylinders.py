@@ -1,0 +1,115 @@
+# Hub-and-spoke ("cylinders") system: PH hub + bound spokes through
+# WheelSpinner, terminating on a certified gap — the TPU analog of
+# ref:mpisppy/tests/test_with_cylinders.py.
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.cylinders import (
+    PHHub, LagrangianOuterBound, XhatXbarInnerBound, XhatShuffleInnerBound,
+    SlamMinHeuristic, SubgradientOuterBound,
+)
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+FARMER_EF_OBJ = -108390.0
+
+
+def farmer_batch(num_scens=3):
+    names = farmer.scenario_names_creator(num_scens)
+    specs = [farmer.scenario_creator(nm, num_scens=num_scens)
+             for nm in names]
+    return batch_mod.from_specs(specs)
+
+
+def hub_dict(batch, rel_gap=5e-3, max_iterations=150):
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=max_iterations,
+                            conv_thresh=0.0,  # let the gap terminate
+                            subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7))
+    return {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": rel_gap}},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": opts, "batch": batch},
+    }
+
+
+def test_wheel_ph_lagrangian_xhatxbar():
+    batch = farmer_batch(3)
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_kwargs": {"options": {}}},
+        {"spoke_class": XhatXbarInnerBound, "opt_kwargs": {"options": {}}},
+    ]
+    ws = WheelSpinner(hub_dict(batch), spokes).spin()
+    inner, outer = ws.BestInnerBound, ws.BestOuterBound
+    assert np.isfinite(inner) and np.isfinite(outer)
+    assert outer <= inner + 2e-3 * abs(inner)
+    # both bounds bracket the EF objective (modulo f32 slack)
+    slack = 2e-3 * abs(FARMER_EF_OBJ)
+    assert outer <= FARMER_EF_OBJ + slack
+    assert inner >= FARMER_EF_OBJ - slack
+    # gap actually certified
+    rel_gap = (inner - outer) / abs(inner)
+    assert rel_gap <= 5e-3 + 1e-6
+    # terminated early thanks to the gap, not the iteration cap
+    assert ws.spcomm._iter < 150
+
+
+def test_wheel_more_spokes():
+    batch = farmer_batch(6)
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_kwargs": {"options": {}}},
+        {"spoke_class": SubgradientOuterBound,
+         "opt_kwargs": {"options": {"rho": 1.0, "n_windows": 10}}},
+        {"spoke_class": XhatShuffleInnerBound,
+         "opt_kwargs": {"options": {"k": 2}}},
+        {"spoke_class": SlamMinHeuristic, "opt_kwargs": {"options": {}}},
+    ]
+    ws = WheelSpinner(hub_dict(batch, rel_gap=1e-2, max_iterations=80),
+                      spokes).spin()
+    inner, outer = ws.BestInnerBound, ws.BestOuterBound
+    assert np.isfinite(inner) and np.isfinite(outer)
+    assert outer <= inner + 2e-3 * abs(inner)
+    # trace recorded per sync
+    assert len(ws.spcomm.trace) == ws.spcomm._iter
+    assert ws.spcomm.trace[-1]["rel_gap"] <= 1e-2 + 1e-6
+
+
+def test_stall_termination():
+    batch = farmer_batch(3)
+    hd = hub_dict(batch, rel_gap=0.0, max_iterations=100)
+    hd["hub_kwargs"]["options"] = {"rel_gap": 0.0,
+                                   "max_stalled_iters": 5}
+    spokes = [
+        {"spoke_class": XhatXbarInnerBound, "opt_kwargs": {"options": {}}},
+    ]
+    ws = WheelSpinner(hd, spokes).spin()
+    # stalls quickly: inner bound stops improving near the optimum
+    assert ws.spcomm._iter < 100
+    assert np.isfinite(ws.BestInnerBound)
+
+
+def test_solution_writers(tmp_path):
+    batch = farmer_batch(3)
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_kwargs": {"options": {}}},
+        {"spoke_class": XhatXbarInnerBound, "opt_kwargs": {"options": {}}},
+    ]
+    ws = WheelSpinner(hub_dict(batch), spokes).spin()
+    f = tmp_path / "sol.npy"
+    ws.write_first_stage_solution(str(f))
+    x1 = np.load(f)
+    # the written solution is the incumbent that achieved BestInnerBound:
+    # re-evaluating it must reproduce the reported bound
+    from mpisppy_tpu.algos import xhat as xhat_mod
+    from mpisppy_tpu.ops import pdhg as pdhg_mod
+    res = xhat_mod.evaluate(batch, np.asarray(x1),
+                            pdhg_mod.PDHGOptions(tol=1e-7))
+    assert bool(res.feasible)
+    assert float(res.value) == pytest.approx(ws.BestInnerBound, rel=1e-4)
+    d = tmp_path / "tree"
+    ws.write_tree_solution(str(d))
+    assert (d / "ROOT.csv").exists()
