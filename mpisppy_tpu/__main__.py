@@ -1,0 +1,5 @@
+# `python -m mpisppy_tpu ...` == the generic_cylinders driver
+# (ref:mpisppy/generic_cylinders.py run as a script).
+from mpisppy_tpu.generic_cylinders import main
+
+main()

@@ -115,11 +115,23 @@ def _prox_qp(batch: ScenarioBatch, W: Array, xbar: Array, z: Array,
 @partial(jax.jit, static_argnames=("opts",))
 def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
     """Iter0: plain scenario solves, xbar, W seed, trivial bound
-    (ref:mpisppy/phbase.py:829-946)."""
+    (ref:mpisppy/phbase.py:829-946).
+
+    The trivial bound (wait-and-see expectation, ref:spopt.py:377) is
+    taken from the DUAL side with a residual certificate: a truncated
+    primal iterate can overshoot the scenario optimum, which would make
+    E[obj] an INVALID outer bound; the Fenchel dual value at a
+    dual-feasible iterate is always valid.  Returns
+    (state, trivial_bound, certified)."""
+    from mpisppy_tpu.ops import boxqp as _boxqp
     st0 = pdhg.init_state(batch.qp, opts.pdhg)
     solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
-    obj = batch.objective(solver.x)
-    trivial_bound = batch.expectation(obj)
+    dual = _boxqp.dual_objective(batch.qp, solver.x, solver.y)
+    _, rd, _ = _boxqp.kkt_residuals(batch.qp, solver.x, solver.y)
+    tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
+    real = batch.p > 0.0
+    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    trivial_bound = batch.expectation(dual)
     zeros = jnp.zeros((batch.num_scenarios, batch.num_nonants),
                       batch.qp.c.dtype)
     zeros_nodes = jnp.zeros((batch.tree.num_nodes, batch.num_nonants),
@@ -129,8 +141,9 @@ def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
                  conv=jnp.asarray(jnp.inf, batch.qp.c.dtype), rho=rho)
     x_non, xbar, xbar_nodes, xsqbar, W, z, conv = _xbar_w_conv(
         batch, st, opts.smooth_beta, False, opts.compute_xsqbar)
-    return dataclasses.replace(st, W=W, xbar=xbar, xbar_nodes=xbar_nodes,
-                               xsqbar=xsqbar, conv=conv), trivial_bound
+    return (dataclasses.replace(st, W=W, xbar=xbar, xbar_nodes=xbar_nodes,
+                                xsqbar=xsqbar, conv=conv),
+            trivial_bound, certified)
 
 
 @partial(jax.jit, static_argnames=("opts",))
@@ -180,13 +193,23 @@ class PH:
         if rho_setter is not None:
             rho_arr = jnp.asarray(rho_setter(batch), batch.qp.c.dtype)
         self.rho = rho_arr
-        self.extobject = extensions(self) if isinstance(extensions, type) \
-            else extensions
-        self.converger_object = converger(self) if isinstance(converger, type) \
-            else converger
+        # `extensions`/`converger` may be a class, a factory taking the
+        # driver (e.g. functools.partial(MultiExtension, ext_classes=…)),
+        # or an already-built object.
+        def _build(thing):
+            if thing is None:
+                return None
+            # classes, functions, and partials are factories taking the
+            # driver; built objects (not callable) pass through
+            if isinstance(thing, type) or callable(thing):
+                return thing(self)
+            return thing
+        self.extobject = _build(extensions)
+        self.converger_object = _build(converger)
         self.spcomm = None
         self.state: PHState | None = None
         self.trivial_bound: float | None = None
+        self.trivial_bound_certified: bool = False
         self._iter = 0
 
     # -- extension callout plumbing (ref:extensions/extension.py:18-151) --
@@ -204,8 +227,9 @@ class PH:
 
     def Iter0(self) -> float:
         self._ext("pre_iter0")
-        self.state, tb = ph_iter0(self.batch, self.rho, self.options)
+        self.state, tb, cert = ph_iter0(self.batch, self.rho, self.options)
         self.trivial_bound = float(tb)
+        self.trivial_bound_certified = bool(cert)
         self._ext("post_iter0")
         global_toc(f"PH Iter0: trivial bound = {self.trivial_bound:.6g}",
                    self.options.display_progress)

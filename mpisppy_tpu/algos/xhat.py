@@ -32,7 +32,8 @@ Array = jax.Array
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["value", "per_scenario", "feasible", "primal_resid"],
+    data_fields=["value", "per_scenario", "feasible", "primal_resid",
+                 "status"],
     meta_fields=[],
 )
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +42,7 @@ class XhatResult:
     per_scenario: Array  # (S,) recourse objective values
     feasible: Array      # () bool — every real scenario feasible at tol
     primal_resid: Array  # (S,) relative primal residuals
+    status: Array        # (S,) int32 pdhg status (INFEASIBLE certified)
 
 
 @partial(jax.jit, static_argnames=("opts", "feas_tol"))
@@ -50,22 +52,30 @@ def evaluate(batch: ScenarioBatch, xhat: Array,
     """E[f(xhat, xi_s)] with nonants fixed to `xhat` ((N,) root-only or
     (num_nodes, N) per-node) — ref:mpisppy/utils/xhat_eval.py:254-340
     (evaluate = _fix_nonants + solve_loop + Eobjective).
-    Infeasibility (recourse cannot satisfy constraints) is detected from
-    the relative primal residual exceeding `feas_tol` (a genuinely
-    infeasible candidate leaves O(1) residual; a converged-but-for-f32
-    solve leaves ~1e-4) and poisons only the scalar `value`, not the
-    per-scenario vector."""
+    Infeasibility (recourse cannot satisfy constraints) is detected two
+    ways, mirroring the reference's per-subproblem status handling
+    (ref:mpisppy/spopt.py:76-96,194-231): a certified per-scenario
+    Farkas certificate from the kernel (status mask), and the relative
+    primal residual exceeding `feas_tol` as a backstop.  An infeasible
+    scenario poisons only the scalar `value`, not the per-scenario
+    vector — the batch is not poisoned."""
     qp = batch.with_fixed_nonants(xhat)
+    opts = dataclasses.replace(opts, detect_infeas=True)
     st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
     # Original-space objective: scaled c,q absorb the column scaling.
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     real = batch.p > 0.0
-    feas = jnp.all(jnp.where(real, rp <= feas_tol, True))
+    # UNBOUNDED is excluded too: a frozen partially-converged iterate of
+    # an unbounded recourse has an arbitrary finite objective that must
+    # not become an incumbent value.
+    scen_ok = (rp <= feas_tol) & (st.status != pdhg.INFEASIBLE) \
+        & (st.status != pdhg.UNBOUNDED)
+    feas = jnp.all(jnp.where(real, scen_ok, True))
     value = jnp.where(feas, batch.expectation(obj),
                       jnp.asarray(jnp.inf, obj.dtype))
     return XhatResult(value=value, per_scenario=obj, feasible=feas,
-                      primal_resid=rp)
+                      primal_resid=rp, status=st.status)
 
 
 def round_integers(batch: ScenarioBatch, xhat: Array) -> Array:
@@ -90,9 +100,11 @@ def xhat_shuffle(batch: ScenarioBatch, x_non: Array, scen_ids: Array,
     x_non: (S, N) current per-scenario nonants; scen_ids: (k,) candidate
     indices (host supplies the deterministic shuffle, seed 42, matching
     ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:61-99).  Returns
-    (values (k,), feasible (k,)) — the host picks the best.
-    The reference tries candidates one at a time across ranks; here the
-    K trials batch into one (k*S)-subproblem program.
+    (values (k,), feasible (k,), cands (k, N)) — the host picks the
+    best; cands is the (rounded) candidate tensor actually evaluated, so
+    callers never recompute it.  The reference tries candidates one at a
+    time across ranks; here the K trials batch into one
+    (k*S)-subproblem program.
     """
     cands = round_integers(batch, x_non[scen_ids])  # (k, N)
 
@@ -101,7 +113,7 @@ def xhat_shuffle(batch: ScenarioBatch, x_non: Array, scen_ids: Array,
         return r.value, r.feasible
 
     values, feas = jax.vmap(one)(cands)
-    return values, feas
+    return values, feas, cands
 
 
 def slam_candidate(batch: ScenarioBatch, x_non: Array,

@@ -1,0 +1,12 @@
+# Convergers: hub-side intra-algorithm termination
+# (ref:mpisppy/convergers/).
+from mpisppy_tpu.convergers.converger import Converger  # noqa: F401
+from mpisppy_tpu.convergers.fracintsnotconv import (  # noqa: F401
+    FractionalConverger,
+)
+from mpisppy_tpu.convergers.norm_rho_converger import (  # noqa: F401
+    NormRhoConverger,
+)
+from mpisppy_tpu.convergers.primal_dual_converger import (  # noqa: F401
+    PrimalDualConverger,
+)

@@ -1,0 +1,20 @@
+###############################################################################
+# Converger ABC (ref:mpisppy/convergers/converger.py:24-47): a hub-side
+# object asked `is_converged()` once per PH iteration, with access to the
+# PH driver (`self.opt`) and thus the device-resident PHState.
+###############################################################################
+from __future__ import annotations
+
+import abc
+
+
+class Converger(abc.ABC):
+    """ref:mpisppy/convergers/converger.py:24."""
+
+    def __init__(self, opt):
+        self.opt = opt
+        self.conv_value: float | None = None
+
+    @abc.abstractmethod
+    def is_converged(self) -> bool:
+        ...

@@ -1,0 +1,40 @@
+###############################################################################
+# FractionalConverger: fraction of integer nonants not yet converged
+# across scenarios (ref:mpisppy/convergers/fracintsnotconv.py:19).
+# "Converged" for an integer slot means every scenario in its tree node
+# agrees with the (rounded) node average to within `ratio_tol`.
+###############################################################################
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.convergers.converger import Converger
+
+
+class FractionalConverger(Converger):
+    """ref:mpisppy/convergers/fracintsnotconv.py:19."""
+
+    def __init__(self, opt):
+        super().__init__(opt)
+        options = getattr(opt, "options", None)
+        odict = getattr(options, "__dict__", {}) if options else {}
+        self.fracthresh = float(
+            getattr(opt, "frac_thresh", odict.get("frac_thresh", 0.05)))
+        self.ratio_tol = 1e-4
+
+    def is_converged(self) -> bool:
+        batch = self.opt.batch
+        mask = np.asarray(batch.integer_slot)
+        if not mask.any():
+            self.conv_value = 0.0
+            return True
+        st = self.opt.state
+        x_non = batch.nonants(st.solver.x)
+        xbar = st.xbar
+        real = (batch.p > 0.0)[:, None]
+        dev = jnp.where(real, jnp.abs(x_non - jnp.round(xbar)), 0.0)
+        slot_conv = jnp.max(dev, axis=0) <= self.ratio_tol   # (N,)
+        notconv = np.asarray(~slot_conv) & mask
+        self.conv_value = float(notconv.sum() / mask.sum())
+        return self.conv_value < self.fracthresh

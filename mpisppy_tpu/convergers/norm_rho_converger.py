@@ -1,0 +1,28 @@
+###############################################################################
+# NormRhoConverger (ref:mpisppy/convergers/norm_rho_converger.py:18):
+# terminate when the rho-weighted primal metric
+#   sum_s p_s || rho * (x_s - xbar) ||_1
+# falls below a threshold — the same quantity NormRhoUpdater adapts on.
+###############################################################################
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mpisppy_tpu.convergers.converger import Converger
+
+
+class NormRhoConverger(Converger):
+    """ref:mpisppy/convergers/norm_rho_converger.py:18."""
+
+    def __init__(self, opt):
+        super().__init__(opt)
+        self.tol = float(getattr(opt, "norm_rho_tol", 1e-4))
+
+    def is_converged(self) -> bool:
+        batch = self.opt.batch
+        st = self.opt.state
+        x_non = batch.nonants(st.solver.x)
+        metric = batch.expectation(
+            jnp.sum(jnp.abs(st.rho * (x_non - st.xbar)), axis=-1))
+        self.conv_value = float(metric)
+        return self.conv_value < self.tol

@@ -219,7 +219,11 @@ def from_specs(specs: list[ScenarioSpec],
         raise ValueError(f"scenario probabilities sum to {probs.sum()}")
 
     def stack(field):
-        arrs = [np.asarray(getattr(sp, field), np.float64) for sp in specs]
+        raw = [getattr(sp, field) for sp in specs]
+        if all(a is raw[0] for a in raw[1:]):
+            # identity fast path: generators share deterministic arrays
+            return np.asarray(raw[0], np.float64)
+        arrs = [np.asarray(a, np.float64) for a in raw]
         first = arrs[0]
         if all(a.shape == first.shape and np.array_equal(a, first)
                for a in arrs[1:]):

@@ -151,8 +151,13 @@ class PHHub(Hub):
                 f" ({self.latest_ob_char}/{self.latest_ib_char})", True)
 
     def is_converged(self) -> bool:
-        # use the PH trivial bound as the initial outer bound (ref:hub.py:544)
-        if self.opt.trivial_bound is not None and self._iter <= 1:
+        # use the PH trivial bound as the initial outer bound
+        # (ref:hub.py:544) — but only when its dual-residual certificate
+        # held: a truncated iter0 primal value can exceed the optimum,
+        # and an invalid outer bound here would fire the "certified" gap
+        # termination wrongly.
+        if (self.opt.trivial_bound is not None and self._iter <= 1
+                and getattr(self.opt, "trivial_bound_certified", False)):
             self.OuterBoundUpdate(self.opt.trivial_bound, "T")
         return self.determine_termination()
 

@@ -193,9 +193,11 @@ class XhatXbarInnerBound(InnerBoundSpoke):
 
     def update(self, hub_payload):
         xbar_nodes = hub_payload["xbar_nodes"]
-        self._pending = (xhat_mod.xhat_xbar(self.batch, xbar_nodes,
-                                            self.pdhg_opts),
-                         xbar_nodes)
+        # cache the ROUNDED candidate: the bound is evaluated at it, so
+        # the incumbent written out must be the same point
+        cand = xhat_mod.round_integers(self.batch, xbar_nodes)
+        self._pending = (xhat_mod.evaluate(self.batch, cand,
+                                           self.pdhg_opts), cand)
 
 
 class XhatShuffleInnerBound(InnerBoundSpoke):
@@ -222,14 +224,13 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
     def update(self, hub_payload):
         x_non = hub_payload["nonants"]
         ids = self._next_ids()
-        cands = xhat_mod.round_integers(self.batch, x_non[ids])
-        self._pending = (xhat_mod.xhat_shuffle(
-            self.batch, x_non, ids, self.k, self.pdhg_opts), cands)
+        self._pending = xhat_mod.xhat_shuffle(
+            self.batch, x_non, ids, self.k, self.pdhg_opts)
 
     def harvest(self):
         if self._pending is None:
             return None
-        (vals, feas), cands = self._pending
+        vals, feas, cands = self._pending
         vals = np.asarray(vals)
         feas = np.asarray(feas)
         if feas.any():

@@ -1,0 +1,5 @@
+# Extension plane: hub plug-ins called at fixed PH callout points
+# (ref:mpisppy/extensions/).
+from mpisppy_tpu.extensions.extension import (  # noqa: F401
+    Extension, MultiExtension,
+)

@@ -1,0 +1,93 @@
+###############################################################################
+# Extension ABC — the hub's callback plane
+# (ref:mpisppy/extensions/extension.py:18-151).  The PH driver calls the
+# hook methods at fixed points (algos/ph.py _ext); extensions read and
+# mutate the driver (`self.opt`): its options, its device-resident
+# PHState (via dataclasses.replace on host), or its batch (e.g. the
+# Fixer collapses nonant boxes).  All 14 reference callout points exist;
+# PH currently drives pre_iter0/post_iter0/miditer/enditer/
+# post_everything, and the cylinder layer drives setup_hub/
+# sync_with_spokes.
+###############################################################################
+from __future__ import annotations
+
+
+class Extension:
+    """ref:mpisppy/extensions/extension.py:18."""
+
+    def __init__(self, ph):
+        self.opt = ph
+
+    def pre_iter0(self):
+        pass
+
+    def iter0_post_solver_creation(self):
+        pass
+
+    def post_iter0(self):
+        pass
+
+    def post_iter0_after_sync(self):
+        pass
+
+    def miditer(self):
+        pass
+
+    def enditer(self):
+        pass
+
+    def enditer_after_sync(self):
+        pass
+
+    def post_everything(self):
+        pass
+
+    def pre_solve_loop(self):
+        pass
+
+    def post_solve_loop(self):
+        pass
+
+    def pre_solve(self, subproblem=None):
+        pass
+
+    def post_solve(self, subproblem=None, results=None):
+        pass
+
+    def setup_hub(self):
+        pass
+
+    def initialize_spoke_indices(self):
+        pass
+
+    def sync_with_spokes(self):
+        pass
+
+
+class MultiExtension(Extension):
+    """Compose several extensions; each hook fans out in order
+    (ref:mpisppy/extensions/extension.py:154-226)."""
+
+    def __init__(self, ph, ext_classes):
+        super().__init__(ph)
+        self.extdict = {}
+        for cls in ext_classes:
+            self.extdict[cls.__name__] = cls(ph)
+
+    def _fan(self, hook, *args):
+        for ext in self.extdict.values():
+            getattr(ext, hook)(*args)
+
+
+for _hook in ["pre_iter0", "iter0_post_solver_creation", "post_iter0",
+              "post_iter0_after_sync", "miditer", "enditer",
+              "enditer_after_sync", "post_everything", "pre_solve_loop",
+              "post_solve_loop", "setup_hub",
+              "initialize_spoke_indices", "sync_with_spokes"]:
+    def _make(h):
+        def f(self, *args):
+            self._fan(h, *args)
+        f.__name__ = h
+        return f
+    setattr(MultiExtension, _hook, _make(_hook))
+del _hook, _make

@@ -1,0 +1,81 @@
+###############################################################################
+# Fixer: WW-style fixing of (near-)converged nonants
+# (ref:mpisppy/extensions/fixer.py:27-335).
+#
+# The reference watches per-variable convergence (xbar/xsqbar variance
+# plus iteration-count lags from a user Fixer_tuple) and fixes Pyomo
+# vars in every scenario.  Here the per-slot statistic is the
+# cross-scenario spread |x_s,i - xbar_i| reduced on device; a slot that
+# stays converged for `lag` consecutive iterations is fixed by
+# collapsing its box in the batch's qp to the (rounded, for integer
+# slots) node average — after which every subsequent batched solve
+# treats it as a constant.  Fixing is monotone (never unfixed), matching
+# the reference default.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+class Fixer(Extension):
+    """options read from ph.options when present: fixer_lag (default 5),
+    fixer_tol (1e-4), fixer_integer_only (True)."""
+
+    def __init__(self, ph):
+        super().__init__(ph)
+        opt = ph.options
+        self.lag = int(getattr(opt, "fixer_lag", 5))
+        self.tol = float(getattr(opt, "fixer_tol", 1e-4))
+        self.integer_only = bool(getattr(opt, "fixer_integer_only", True))
+        N = ph.batch.num_nonants
+        self._streak = np.zeros(N, np.int64)
+        self.fixed_mask = np.zeros(N, bool)
+
+    def nfixed(self) -> int:
+        return int(self.fixed_mask.sum())
+
+    def enditer(self):
+        ph = self.opt
+        batch = ph.batch
+        st = ph.state
+        x_non = batch.nonants(st.solver.x)
+        real = (batch.p > 0.0)[:, None]
+        spread = np.asarray(jnp.max(
+            jnp.where(real, jnp.abs(x_non - st.xbar), 0.0), axis=0))
+        conv = spread <= self.tol
+        self._streak = np.where(conv, self._streak + 1, 0)
+
+        eligible = ~self.fixed_mask & (self._streak >= self.lag)
+        if self.integer_only:
+            eligible &= np.asarray(batch.integer_slot)
+        if not eligible.any():
+            return
+
+        idx = np.nonzero(eligible)[0]
+        # per-scenario fix values: each scenario's slot is pinned to ITS
+        # owning tree node's average (multistage-correct; for two-stage
+        # every row reads the ROOT average)
+        node_of_slot = np.asarray(batch.node_of_slot)          # (S, N)
+        xbar_nodes = np.asarray(st.xbar_nodes)                 # (nodes, N)
+        vals = xbar_nodes[node_of_slot[:, idx], idx]           # (S, k)
+        is_int = np.asarray(batch.integer_slot)[idx]
+        vals = np.where(is_int, np.round(vals), vals)
+
+        # collapse the box at the fixed slots (scaled space, per scenario)
+        qp = batch.qp
+        d_non = np.asarray(batch.d_non)
+        d = d_non[idx] if d_non.ndim == 1 else d_non[:, idx]
+        cols = np.asarray(batch.nonant_idx)[idx]
+        xs = jnp.asarray(vals / d, qp.l.dtype)                 # (S, k)
+        S, n = batch.qp.c.shape
+        l_full = jnp.broadcast_to(qp.l, (S, n))
+        u_full = jnp.broadcast_to(qp.u, (S, n))
+        ph.batch = dataclasses.replace(batch, qp=dataclasses.replace(
+            qp, l=l_full.at[:, cols].set(xs),
+            u=u_full.at[:, cols].set(xs)))
+        self.fixed_mask[idx] = True

@@ -1,0 +1,32 @@
+###############################################################################
+# Gapper (ref:mpisppy/extensions/mipgapper.py:16-62): per-iteration
+# solver-effort schedule.  The reference tightens the subproblem MIP gap
+# as PH progresses; the TPU analog of "solver effort" is the PDHG window
+# budget per PH iteration, so the schedule maps PH iteration ->
+# subproblem_windows.  Changing the (static) budget recompiles the PH
+# step once per distinct value — schedules should use a handful of
+# values, exactly like the reference's gap dictionaries.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+class Gapper(Extension):
+    """schedule: {iteration: subproblem_windows}; read from
+    ph.options.mipgapdict when present."""
+
+    def __init__(self, ph, schedule: dict | None = None):
+        super().__init__(ph)
+        self.schedule = dict(schedule
+                             or getattr(ph.options, "mipgapdict", None)
+                             or {})
+
+    def miditer(self):
+        k = self.opt._iter
+        if k in self.schedule:
+            self.opt.options = dataclasses.replace(
+                self.opt.options,
+                subproblem_windows=int(self.schedule[k]))

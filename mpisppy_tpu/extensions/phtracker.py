@@ -1,0 +1,55 @@
+###############################################################################
+# PHTracker: per-iteration csv tracking of convergence, bounds, gaps and
+# (optionally) nonants/Ws (ref:mpisppy/extensions/phtracker.py:22-580).
+# One row per PH iteration into <folder>/<name>.csv; tensor dumps go to
+# npz per iteration when track_nonants/track_duals is set.
+###############################################################################
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+class PHTracker(Extension):
+    def __init__(self, ph, folder: str | None = None, name: str = "hub",
+                 track_nonants: bool = False, track_duals: bool = False):
+        super().__init__(ph)
+        self.folder = folder or getattr(ph.options, "tracking_folder",
+                                        None) or "phtracker_out"
+        self.name = name
+        self.track_nonants = track_nonants
+        self.track_duals = track_duals
+        os.makedirs(self.folder, exist_ok=True)
+        self._f = open(os.path.join(self.folder, f"{name}.csv"), "w")
+        self._f.write("iteration,conv,eobj,outer,inner,rel_gap\n")
+
+    def _bounds(self):
+        sp = self.opt.spcomm
+        if sp is None:
+            return float("nan"), float("nan"), float("nan")
+        abs_gap, rel_gap = sp.compute_gaps()
+        return sp.BestOuterBound, sp.BestInnerBound, rel_gap
+
+    def enditer(self):
+        ph = self.opt
+        k = ph._iter
+        conv = float(ph.state.conv)
+        eobj = ph.Eobjective()
+        outer, inner, rel_gap = self._bounds()
+        self._f.write(f"{k},{conv},{eobj},{outer},{inner},{rel_gap}\n")
+        self._f.flush()
+        if self.track_nonants or self.track_duals:
+            payload = {}
+            if self.track_nonants:
+                payload["nonants"] = np.asarray(
+                    ph.batch.nonants(ph.state.solver.x))
+            if self.track_duals:
+                payload["W"] = np.asarray(ph.state.W)
+            np.savez(os.path.join(self.folder,
+                                  f"{self.name}_iter{k}.npz"), **payload)
+
+    def post_everything(self):
+        self._f.close()

@@ -1,0 +1,103 @@
+###############################################################################
+# Rho adaptation family (ref:mpisppy/extensions/norm_rho_updater.py:39,
+# sep_rho.py:17, coeff_rho.py:15).
+#
+# All three mutate the (N,)-vector rho carried in the device PHState —
+# a host-side dataclasses.replace between jitted steps, no recompile
+# (rho is data, not a static).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+def _set_rho(ph, rho_new) -> None:
+    rho = jnp.asarray(rho_new, ph.batch.qp.c.dtype)
+    ph.rho = rho
+    if ph.state is not None:
+        ph.state = dataclasses.replace(ph.state, rho=rho)
+
+
+def _orig_cost_per_slot(batch) -> np.ndarray:
+    """|c_i| of each nonant slot in ORIGINAL space, averaged over
+    scenarios (scaled c absorbs d_col: c_orig = c_scaled / d_col)."""
+    c = np.asarray(batch.qp.c)
+    d_col = np.asarray(batch.d_col)
+    idx = np.asarray(batch.nonant_idx)
+    c_orig = c / d_col
+    c_non = c_orig[..., idx]
+    if c_non.ndim == 2:
+        c_non = np.abs(c_non).mean(axis=0)
+    return np.abs(c_non)
+
+
+class NormRhoUpdater(Extension):
+    """Residual balancing (ref:mpisppy/extensions/norm_rho_updater.py:39):
+    grow rho when the primal nonanticipativity residual dominates the
+    dual movement, shrink when the dual dominates (ADMM mu/tau rule)."""
+
+    def __init__(self, ph, mu: float = 10.0, tau: float = 2.0):
+        super().__init__(ph)
+        self.mu = mu
+        self.tau = tau
+        self._prev_xbar = None
+
+    def enditer(self):
+        ph = self.opt
+        st = ph.state
+        batch = ph.batch
+        x_non = batch.nonants(st.solver.x)
+        primal = float(batch.expectation(
+            jnp.sum(jnp.abs(x_non - st.xbar), axis=-1)))
+        xbar_nodes = np.asarray(st.xbar_nodes)
+        if self._prev_xbar is not None:
+            rho = np.asarray(st.rho)
+            dual = float(np.sum(np.abs(
+                rho.mean() * (xbar_nodes - self._prev_xbar))))
+            if dual > 0:
+                if primal > self.mu * dual:
+                    _set_rho(ph, np.asarray(st.rho) * self.tau)
+                elif dual > self.mu * primal:
+                    _set_rho(ph, np.asarray(st.rho) / self.tau)
+        self._prev_xbar = xbar_nodes
+
+
+class SepRho(Extension):
+    """Watson-Woodruff per-variable rho (ref:mpisppy/extensions/
+    sep_rho.py:17): rho_i = |c_i| / (max_s x_i - min_s x_i + 1), from
+    the iter0 solutions."""
+
+    def __init__(self, ph, multiplier: float = 1.0):
+        super().__init__(ph)
+        self.multiplier = float(
+            getattr(ph.options, "sep_rho_multiplier", multiplier))
+
+    def post_iter0(self):
+        ph = self.opt
+        batch = ph.batch
+        x_non = np.asarray(batch.nonants(ph.state.solver.x))
+        real = np.asarray(batch.p > 0.0)
+        xr = x_non[real]
+        spread = xr.max(axis=0) - xr.min(axis=0)
+        cost = _orig_cost_per_slot(batch)
+        _set_rho(ph, self.multiplier * cost / (spread + 1.0))
+
+
+class CoeffRho(Extension):
+    """rho_i = multiplier * |c_i|
+    (ref:mpisppy/extensions/coeff_rho.py:15)."""
+
+    def __init__(self, ph, multiplier: float = 0.1):
+        super().__init__(ph)
+        self.multiplier = float(
+            getattr(ph.options, "coeff_rho_multiplier", multiplier))
+
+    def post_iter0(self):
+        batch = self.opt.batch
+        cost = _orig_cost_per_slot(batch)
+        _set_rho(self.opt, self.multiplier * np.maximum(cost, 1e-6))

@@ -1,0 +1,169 @@
+###############################################################################
+# Generic driver CLI — the flagship entry point
+# (ref:mpisppy/generic_cylinders.py:32-312,396-457):
+#
+#   python -m mpisppy_tpu --module-name mpisppy_tpu.models.farmer \
+#          --num-scens 3 --default-rho 1.0 --lagrangian --xhatxbar \
+#          --rel-gap 0.01 [--EF] [--solution-base-name out]
+#
+# The model module supplies the reference's 5-function API
+# (ref:mpisppy/generic_cylinders.py:43-52): scenario_creator,
+# scenario_names_creator, inparser_adder, kw_creator,
+# scenario_denouement — returning ScenarioSpec instead of Pyomo models.
+# Multistage modules additionally provide make_tree(branching_factors).
+###############################################################################
+from __future__ import annotations
+
+import importlib
+import json
+import sys
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+from mpisppy_tpu.utils import cfg_vanilla as vanilla
+from mpisppy_tpu.utils.config import Config
+
+
+def _parse_args(module, args=None):
+    """ref:generic_cylinders.py:32-80."""
+    cfg = Config()
+    cfg.add_to_config("module_name", "model module to import", str, None)
+    cfg.add_to_config("EF", "solve the extensive form directly", bool,
+                      False)
+    cfg.add_to_config("solution_base_name",
+                      "write first-stage solution files with this base",
+                      str, None)
+    cfg.num_scens_optional()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    cfg.lagrangian_args()
+    cfg.lagranger_args()
+    cfg.subgradient_args()
+    cfg.xhatxbar_args()
+    cfg.xhatshuffle_args()
+    cfg.slama_args()
+    cfg.converger_args()
+    cfg.wxbar_read_write_args()
+    cfg.multistage()
+    module.inparser_adder(cfg)
+    cfg.parse_command_line("mpisppy_tpu.generic_cylinders", args)
+    cfg.checker()
+    return cfg
+
+
+def _model_plumbing(cfg, module):
+    """Names, creator kwargs, and tree — the scenario count may come
+    from --num-scens, the instance (e.g. sslp_15_45_10), or the
+    branching factors (multistage)."""
+    num_scens = cfg.get("num_scens")
+    kwargs = module.kw_creator(cfg)
+    if num_scens is None:
+        num_scens = kwargs.get("num_scens")
+    if num_scens is None and cfg.get("branching_factors"):
+        import math
+        num_scens = math.prod(cfg["branching_factors"])
+    if num_scens is None:
+        raise SystemExit("need --num-scens (or an instance implying it)")
+    names = module.scenario_names_creator(int(num_scens))
+    tree = None
+    if hasattr(module, "make_tree") and cfg.get("branching_factors"):
+        tree = module.make_tree(tuple(cfg["branching_factors"]))
+    elif hasattr(module, "make_tree"):
+        tree = module.make_tree()
+    return names, kwargs, tree
+
+
+def _build_batch(cfg, module):
+    names, kwargs, tree = _model_plumbing(cfg, module)
+    specs = [module.scenario_creator(nm, **kwargs) for nm in names]
+    return batch_mod.from_specs(specs, tree=tree), names, specs
+
+
+def _do_EF(cfg, module):
+    """ref:generic_cylinders.py:396-457."""
+    from mpisppy_tpu.algos import ef as ef_mod
+    names, kwargs, tree = _model_plumbing(cfg, module)
+    ef = ef_mod.ExtensiveForm({"tol": cfg.get("pdhg_tol", 1e-6)},
+                              names, module.scenario_creator, kwargs,
+                              tree=tree)
+    st = ef.solve_extensive_form()
+    obj = ef.get_objective_value()
+    global_toc(f"EF objective: {obj:.6g} "
+               f"(converged={bool(st.done.all())})", True)
+    if cfg.get("solution_base_name"):
+        import numpy as np
+        np.save(cfg["solution_base_name"] + ".npy",
+                np.asarray(list(ef.get_root_solution().values())))
+    print(json.dumps({"EF_objective": obj,
+                      "converged": bool(st.done.all())}))
+    return ef
+
+
+def _do_decomp(cfg, module):
+    """ref:generic_cylinders.py:109-312."""
+    batch, names, specs = _build_batch(cfg, module)
+    hub = vanilla.ph_hub(cfg, batch, scenario_names=names)
+    spokes = []
+    if cfg.get("lagrangian"):
+        spokes.append(vanilla.lagrangian_spoke(cfg))
+    if cfg.get("lagranger"):
+        spokes.append(vanilla.lagranger_spoke(cfg))
+    if cfg.get("subgradient"):
+        spokes.append(vanilla.subgradient_spoke(cfg))
+    if cfg.get("xhatxbar"):
+        spokes.append(vanilla.xhatxbar_spoke(cfg))
+    if cfg.get("xhatshuffle"):
+        spokes.append(vanilla.xhatshuffle_spoke(cfg))
+    if cfg.get("slammax"):
+        spokes.append(vanilla.slammax_spoke(cfg))
+    if cfg.get("slammin"):
+        spokes.append(vanilla.slammin_spoke(cfg))
+
+    wheel = WheelSpinner(hub, spokes)
+    wheel.spin()
+    abs_gap, rel_gap = wheel.spcomm.compute_gaps()
+    global_toc(
+        f"outer {wheel.BestOuterBound:.6g} inner {wheel.BestInnerBound:.6g}"
+        f" rel_gap {rel_gap:.3e}", True)
+    if cfg.get("solution_base_name"):
+        wheel.write_first_stage_solution(
+            cfg["solution_base_name"] + ".csv")
+    for rank0, nm in enumerate(names):
+        module.scenario_denouement(0, nm, specs[rank0])
+
+    def _finite(v):  # strict-JSON safe: a bound that never landed -> null
+        import math
+        return v if isinstance(v, (int, float)) and math.isfinite(v) \
+            else None
+    print(json.dumps({
+        "outer_bound": _finite(wheel.BestOuterBound),
+        "inner_bound": _finite(wheel.BestInnerBound),
+        "abs_gap": _finite(abs_gap), "rel_gap": _finite(rel_gap),
+        "iterations": wheel.spcomm._iter,
+    }))
+    return wheel
+
+
+def main(args=None):
+    argv = list(sys.argv[1:] if args is None else args)
+    module_name = None
+    for i, a in enumerate(argv):
+        if a == "--module-name":
+            module_name = argv[i + 1]
+        elif a.startswith("--module-name="):
+            module_name = a.split("=", 1)[1]
+    if module_name is None:
+        raise SystemExit(
+            "usage: python -m mpisppy_tpu --module-name <module> ...")
+    sys.path.insert(0, ".")
+    module = importlib.import_module(module_name)
+    cfg = _parse_args(module, argv)
+    if cfg.get("EF"):
+        return _do_EF(cfg, module)
+    return _do_decomp(cfg, module)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,10 +21,15 @@
 # Algorithm: Chambolle-Pock primal-dual hybrid gradient with
 #   - exact prox of c'x + 1/2 q x^2 over [l,u] (diagonal q),
 #   - dual prox of the [bl,bu] row-indicator via Moreau,
-#   - restart-to-average every `restart_period` iterations, keeping the
-#     better of {current, window average} by relative KKT score,
+#   - ADAPTIVE restart-to-average: candidates (better of {current,
+#     window average} by relative KKT score) are evaluated every
+#     `restart_period` iterations, but a restart fires only on
+#     sufficient score decay (or at a forced window cap) — per batch
+#     element.  A fixed short restart cadence stalls on degenerate LPs
+#     (observed on the sslp extensive form: 200k iters stuck at 1.7e-2
+#     primal residual vs 1.6k iters with longer windows),
 #   - adaptive primal weight omega rebalancing primal/dual step sizes
-#     (tau = omega/||A||, sigma = 1/(omega ||A||)),
+#     (tau = omega/||A||, sigma = 1/(omega ||A||)), updated at restarts,
 # following the PDLP recipe (Applegate et al.; see also MPAX in
 # PAPERS.md) re-implemented from the math, not from any codebase.
 ###############################################################################
@@ -36,9 +41,19 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from mpisppy_tpu.ops.boxqp import BoxQP, kkt_residuals
+from mpisppy_tpu.ops.boxqp import (
+    BoxQP, infeasibility_certificate, kkt_residuals,
+    unboundedness_certificate,
+)
 
 Array = jax.Array
+
+# Per-problem statuses (ref:mpisppy/spopt.py:76-96,194-231 reads these
+# off Gurobi; here the kernel certifies them itself).
+RUNNING = 0       # not terminated (hit max_iters => unconverged)
+OPTIMAL = 1
+INFEASIBLE = 2    # certified by a Farkas ray
+UNBOUNDED = 3     # certified by a recession direction with c'd < 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,19 +62,24 @@ class PDHGOptions:
 
     tol: float = 1e-6  # floored at 5*eps of the working dtype at solve time
     max_iters: int = 20_000
-    restart_period: int = 40
+    restart_period: int = 40   # candidate-check cadence (iterations)
     omega0: float = 1.0
     power_iters: int = 30
     omega_min: float = 1e-4
     omega_max: float = 1e4
     step_margin: float = 0.99  # tau*sigma*||A||^2 = step_margin^2 < 1
+    restart_decay: float = 0.5  # restart on score <= decay * score@restart
+    max_window: int = 16        # forced restart after this many periods
+    detect_infeas: bool = False  # per-problem Farkas/recession certificates
+    certificate_tol: float = 1e-4
 
 
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=[
         "x", "y", "x_sum", "y_sum", "x_anchor", "y_anchor",
-        "omega", "Lnorm", "k", "score", "done",
+        "omega", "Lnorm", "k", "nwin", "restart_score", "score", "done",
+        "status",
     ],
     meta_fields=[],
 )
@@ -74,8 +94,11 @@ class PDHGState:
     omega: Array    # (...,) primal weight
     Lnorm: Array    # (...,) ||A||_2 estimate
     k: Array        # () global iteration counter
+    nwin: Array     # (...,) iterations since this problem's last restart
+    restart_score: Array  # (...,) candidate score at last restart
     score: Array    # (...,) last max relative KKT residual
     done: Array     # (...,) bool
+    status: Array   # (...,) int32 RUNNING/OPTIMAL/INFEASIBLE/UNBOUNDED
 
 
 def _bshape(p: BoxQP):
@@ -127,8 +150,11 @@ def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
         omega=jnp.full(bs, opts.omega0, dt),
         Lnorm=L.astype(dt),
         k=jnp.zeros((), jnp.int32),
+        nwin=jnp.zeros(bs, jnp.int32),
+        restart_score=jnp.full(bs, jnp.inf, dt),
         score=jnp.full(bs, jnp.inf, dt),
         done=jnp.zeros(bs, bool),
+        status=jnp.zeros(bs, jnp.int32),
     )
 
 
@@ -149,8 +175,18 @@ def _pdhg_iter(p: BoxQP, st: PDHGState, tau: Array, sigma: Array) -> PDHGState:
 
 
 def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
-    """Restart-to-average + omega adaptation + convergence check."""
-    navg = jnp.asarray(opts.restart_period, st.x.dtype)
+    """Adaptive restart-to-average + omega adaptation + convergence check.
+
+    Every call evaluates the restart candidate (the better of the
+    current iterate and the window average by relative KKT score), but
+    the restart — adopt candidate, clear the window, adapt omega — only
+    fires per batch element when the candidate score has decayed to
+    `restart_decay` of the score at that element's last restart, or the
+    window hits `max_window` periods (PDLP's artificial restart).  A
+    short fixed cadence provably stalls on degenerate LPs; an
+    ever-growing window goes stale — this is the standard middle ground.
+    """
+    navg = jnp.maximum(st.nwin, 1).astype(st.x.dtype)[..., None]
     xa, ya = st.x_sum / navg, st.y_sum / navg
 
     rp_c, rd_c, rg_c = kkt_residuals(p, st.x, st.y)
@@ -169,28 +205,63 @@ def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     # f32) sits below the 1e-6 default so ordinary tolerances are
     # honored exactly.
     tol = jnp.maximum(opts.tol, 5.0 * jnp.finfo(st.x.dtype).eps)
+    newly_done = score <= tol
 
-    # Primal-weight adaptation (theta = 0.5 log-space smoothing).
+    fire = (score <= opts.restart_decay * st.restart_score) \
+        | (st.nwin >= opts.max_window * opts.restart_period) \
+        | newly_done
+
+    # Primal-weight adaptation (theta = 0.5 log-space smoothing),
+    # applied only at restarts.  Balance criterion: with tau = omega/L
+    # and sigma = 1/(omega*L), equalizing per-window travel
+    # |dx|/tau = |dy|/sigma gives omega ~ |dx|/|dy| — i.e. a fast-moving
+    # DUAL shrinks omega (bigger dual steps).  The inverted ratio
+    # (dy/dx) is a positive feedback loop that blew omega up to O(100)
+    # and stalled fixed-nonant recourse solves.
     dx = jnp.linalg.norm(xr - st.x_anchor, axis=-1)
     dy = jnp.linalg.norm(yr - st.y_anchor, axis=-1)
-    valid = (dx > 1e-12) & (dy > 1e-12)
-    omega_new = jnp.exp(0.5 * jnp.log(jnp.where(valid, dy / jnp.maximum(dx, 1e-30), 1.0))
+    valid = fire & (dx > 1e-12) & (dy > 1e-12)
+    omega_new = jnp.exp(0.5 * jnp.log(jnp.where(valid, dx / jnp.maximum(dy, 1e-30), 1.0))
                         + 0.5 * jnp.log(st.omega))
     omega = jnp.clip(jnp.where(valid, omega_new, st.omega),
                      opts.omega_min, opts.omega_max)
 
-    keep = st.done
+    status = jnp.where(~st.done & newly_done, OPTIMAL, st.status)
+    if opts.detect_infeas:
+        # Approximate rays: the per-window displacement converges to the
+        # infimal displacement vector — nonzero dual part certifies
+        # primal infeasibility, nonzero primal part + descent certifies
+        # unboundedness (PDLP's detection recipe, from the math).
+        # Detection is gated on the solve being far from converged: near
+        # optimality q(y*) can round to +O(eps) in f32 and the iterate
+        # test would false-positive; an infeasible/unbounded problem
+        # never gets a small KKT score, so nothing real is lost.
+        ctol = opts.certificate_tol
+        far = score > jnp.maximum(1e-3, 10.0 * tol)
+        infeas = far & (infeasibility_certificate(p, yr - st.y_anchor, ctol)
+                        | infeasibility_certificate(p, yr, ctol))
+        unbd = far & unboundedness_certificate(p, xr - st.x_anchor, ctol)
+        status = jnp.where(~st.done & ~newly_done & infeas, INFEASIBLE,
+                           status)
+        status = jnp.where((status == RUNNING) & unbd, UNBOUNDED, status)
+        newly_done = newly_done | ((status != RUNNING) & ~st.done)
+
+    act = fire & ~st.done           # restart these elements
+    actx = act[..., None]
     return dataclasses.replace(
         st,
-        x=jnp.where(keep[..., None], st.x, xr),
-        y=jnp.where(keep[..., None], st.y, yr),
-        x_sum=jnp.zeros_like(st.x_sum),
-        y_sum=jnp.zeros_like(st.y_sum),
-        x_anchor=jnp.where(keep[..., None], st.x_anchor, xr),
-        y_anchor=jnp.where(keep[..., None], st.y_anchor, yr),
-        omega=jnp.where(keep, st.omega, omega),
-        score=jnp.where(keep, st.score, score),
-        done=keep | (score <= tol),
+        x=jnp.where(actx, xr, st.x),
+        y=jnp.where(actx, yr, st.y),
+        x_sum=jnp.where(actx, 0.0, st.x_sum),
+        y_sum=jnp.where(actx, 0.0, st.y_sum),
+        x_anchor=jnp.where(actx, xr, st.x_anchor),
+        y_anchor=jnp.where(actx, yr, st.y_anchor),
+        omega=jnp.where(st.done, st.omega, omega),
+        nwin=jnp.where(act, 0, st.nwin),
+        restart_score=jnp.where(act, score, st.restart_score),
+        score=jnp.where(st.done, st.score, score),
+        done=st.done | newly_done,
+        status=status,
     )
 
 
@@ -200,6 +271,7 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     st = jax.lax.fori_loop(
         0, opts.restart_period, lambda _, s: _pdhg_iter(p, s, tau, sigma), st
     )
+    st = dataclasses.replace(st, nwin=st.nwin + opts.restart_period)
     st = _restart(p, st, opts)
     return dataclasses.replace(st, k=st.k + opts.restart_period)
 
@@ -217,8 +289,11 @@ def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
             x_sum=jnp.zeros_like(state.x), y_sum=jnp.zeros_like(state.y),
             x_anchor=state.x, y_anchor=state.y,
             k=jnp.zeros((), jnp.int32),
+            nwin=jnp.zeros_like(state.nwin),
+            restart_score=jnp.full(state.omega.shape, jnp.inf, state.x.dtype),
             score=jnp.full(state.omega.shape, jnp.inf, state.x.dtype),
             done=jnp.zeros(state.omega.shape, bool),
+            status=jnp.zeros_like(state.status),
         )
 
     def cond(s):
@@ -237,7 +312,10 @@ def solve_fixed(p: BoxQP, n_windows: int, opts: PDHGOptions,
         state,
         x_sum=jnp.zeros_like(state.x), y_sum=jnp.zeros_like(state.y),
         x_anchor=state.x, y_anchor=state.y,
+        nwin=jnp.zeros_like(state.nwin),
+        restart_score=jnp.full(state.omega.shape, jnp.inf, state.x.dtype),
         done=jnp.zeros(state.omega.shape, bool),
+        status=jnp.zeros_like(state.status),
     )
     return jax.lax.fori_loop(0, n_windows, lambda _, s: _window(p, s, opts), st)
 

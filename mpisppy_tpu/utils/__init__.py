@@ -1,0 +1,1 @@
+# Utility plane: config, vanilla hub/spoke factories, W/xbar I-O.

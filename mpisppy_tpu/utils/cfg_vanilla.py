@@ -1,0 +1,120 @@
+###############################################################################
+# Vanilla hub/spoke dict factories — the one-stop shop consumed by
+# WheelSpinner, keyed off a Config (ref:mpisppy/utils/cfg_vanilla.py:
+# ph_hub:93, lagrangian_spoke:436, subgradient_spoke:526,
+# xhatxbar_spoke:589, xhatshuffle_spoke:622, slammax/min_spoke:701/722).
+#
+# The reference factories package (opt_class, comm_class, options) per
+# MPI cylinder; here they package the same dicts for the single-program
+# wheel: the hub owns the PH driver on the scenario batch, each spoke is
+# a batched device computation.
+###############################################################################
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.cylinders.hub import PHHub
+from mpisppy_tpu.cylinders import spoke as spoke_mod
+from mpisppy_tpu.ops import pdhg
+
+
+def _pdhg_opts(cfg) -> pdhg.PDHGOptions:
+    return pdhg.PDHGOptions(tol=cfg.get("pdhg_tol", 1e-6))
+
+
+def ph_options(cfg) -> ph_mod.PHOptions:
+    return ph_mod.PHOptions(
+        default_rho=cfg.get("default_rho", 1.0),
+        max_iterations=cfg.get("max_iterations", 100),
+        conv_thresh=cfg.get("convthresh", 1e-4),
+        subproblem_windows=cfg.get("subproblem_windows", 8),
+        iter0_windows=cfg.get("iter0_windows", 400),
+        pdhg=_pdhg_opts(cfg),
+        smoothed=cfg.get("smoothed", False),
+        smooth_beta=cfg.get("defaultPHbeta", 0.2),
+        smooth_p=cfg.get("defaultPHp", 0.0),
+        display_progress=cfg.get("display_progress", False),
+        time_limit=cfg.get("time_limit"),
+    )
+
+
+def ph_hub(cfg, batch, scenario_names=None, rho_setter=None,
+           extensions=None, converger=None) -> dict:
+    """ref:cfg_vanilla.py:93-141."""
+    hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
+                "display_progress": cfg.get("display_progress", False)}
+    if cfg.get("abs_gap") is not None:
+        hub_opts["abs_gap"] = cfg["abs_gap"]
+    if cfg.get("max_stalled_iters") is not None:
+        hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    return {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": hub_opts},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {
+            "options": ph_options(cfg),
+            "batch": batch,
+            "scenario_names": scenario_names,
+            "rho_setter": rho_setter,
+            "extensions": extensions,
+            "converger": converger,
+        },
+    }
+
+
+def _spoke(cls, options=None) -> dict:
+    return {"spoke_class": cls, "opt_kwargs": {"options": options or {}}}
+
+
+def lagrangian_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:436-465."""
+    return _spoke(spoke_mod.LagrangianOuterBound,
+                  {"pdhg_opts": _pdhg_opts(cfg)})
+
+
+def lagranger_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:493-525."""
+    import json
+    rescale = {}
+    fname = cfg.get("lagranger_rho_rescale_factors_json")
+    if fname:
+        with open(fname) as f:
+            rescale = {int(k): float(v) for k, v in json.load(f).items()}
+    return _spoke(spoke_mod.LagrangerOuterBound,
+                  {"pdhg_opts": _pdhg_opts(cfg),
+                   "rho": cfg.get("default_rho", 1.0),
+                   "rho_rescale_factors": rescale})
+
+
+def subgradient_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:526-558."""
+    return _spoke(spoke_mod.SubgradientOuterBound,
+                  {"pdhg_opts": _pdhg_opts(cfg),
+                   "rho": cfg.get("subgradient_rho",
+                                  cfg.get("default_rho", 1.0))})
+
+
+def xhatxbar_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:589-621."""
+    return _spoke(spoke_mod.XhatXbarInnerBound,
+                  {"pdhg_opts": _pdhg_opts(cfg)})
+
+
+def xhatshuffle_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:622-655."""
+    return _spoke(spoke_mod.XhatShuffleInnerBound,
+                  {"pdhg_opts": _pdhg_opts(cfg),
+                   "k": cfg.get("xhatshuffle_iter_step", 4)})
+
+
+def slammax_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:701-721."""
+    return _spoke(spoke_mod.SlamMaxHeuristic,
+                  {"pdhg_opts": _pdhg_opts(cfg)})
+
+
+def slammin_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:722-742."""
+    return _spoke(spoke_mod.SlamMinHeuristic,
+                  {"pdhg_opts": _pdhg_opts(cfg)})

@@ -1,0 +1,249 @@
+###############################################################################
+# Config: the framework's option system with an argparse bridge.
+#
+# The reference wraps pyomo's ConfigDict and auto-generates argparse
+# flags from declared options, with ~45 canned groups
+# (ref:mpisppy/utils/config.py:54-157 and the *_args group functions at
+# :174-976).  Here Config is a small self-contained dict-of-entries with
+# the same surface: add_to_config(), attribute/dict access, quick_assign,
+# canned groups (popular_args, ph_args, ...), and parse_command_line()
+# building an argparse parser from the declared entries (dashes in flag
+# names, underscores in attribute names — same convention).
+###############################################################################
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class _Entry:
+    name: str
+    description: str
+    domain: type | None
+    default: Any
+    value: Any
+    argparse: bool = True
+    complain: bool = False
+
+
+def _boolify(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """ref:mpisppy/utils/config.py:54 — declare options, then parse."""
+
+    def __init__(self):
+        object.__setattr__(self, "_entries", {})
+
+    # -- core declaration/access (ref:config.py:64-140) -------------------
+    def add_to_config(self, name: str, description: str, domain=str,
+                      default=None, argparse: bool = True,
+                      complain: bool = False):
+        if name in self._entries:
+            if complain:
+                raise RuntimeError(f"option {name} already declared")
+            return
+        self._entries[name] = _Entry(name, description, domain, default,
+                                     default, argparse)
+
+    def quick_assign(self, name: str, domain=str, value=None):
+        """declare-and-set (ref:config.py:118)."""
+        self.add_to_config(name, name, domain, value, argparse=False)
+        self._entries[name].value = value
+
+    def add_and_assign(self, name: str, description: str, domain, default,
+                       value):
+        self.add_to_config(name, description, domain, default,
+                           argparse=False)
+        self._entries[name].value = value
+
+    def __getattr__(self, name):
+        entries = object.__getattribute__(self, "_entries")
+        if name in entries:
+            return entries[name].value
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in self._entries:
+            self._entries[name].value = value
+        else:
+            self.quick_assign(name, type(value), value)
+
+    def __contains__(self, name):
+        return name in self._entries
+
+    def __getitem__(self, name):
+        return self._entries[name].value
+
+    def get(self, name, default=None):
+        e = self._entries.get(name)
+        return default if e is None or e.value is None else e.value
+
+    def keys(self):
+        return self._entries.keys()
+
+    def items(self):
+        return {k: e.value for k, e in self._entries.items()}.items()
+
+    # -- canned groups (ref:config.py:174-976) ----------------------------
+    def num_scens_required(self):
+        self.add_to_config("num_scens", "number of scenarios", int, None)
+
+    def num_scens_optional(self):
+        self.add_to_config("num_scens", "number of scenarios", int, None)
+
+    def popular_args(self):
+        """ref:config.py:174-249 (solver options dropped: the kernel is
+        in-repo; PDHG knobs take their place)."""
+        self.add_to_config("max_iterations", "PH max iterations", int, 100)
+        self.add_to_config("time_limit", "wall clock limit (sec)", float,
+                           None)
+        self.add_to_config("default_rho", "PH rho", float, 1.0)
+        self.add_to_config("rel_gap", "relative termination gap", float,
+                           0.01)
+        self.add_to_config("abs_gap", "absolute termination gap", float,
+                           None)
+        self.add_to_config("max_stalled_iters", "stall termination", int,
+                           None)
+        self.add_to_config("display_progress", "per-iter trace", bool,
+                           False)
+        self.add_to_config("tee_rank0_solves", "verbose solves", bool,
+                           False)
+        self.add_to_config("pdhg_tol", "subproblem KKT tolerance", float,
+                           1e-6)
+        self.add_to_config("subproblem_windows",
+                           "PDHG restart windows per PH iteration", int, 8)
+
+    def two_sided_args(self):
+        self.add_to_config("rel_gap", "relative termination gap", float,
+                           0.01)
+        self.add_to_config("abs_gap", "absolute termination gap", float,
+                           None)
+
+    def ph_args(self):
+        """ref:config.py:250-315."""
+        self.popular_args()
+        self.add_to_config("convthresh", "PH convergence threshold", float,
+                           1e-4)
+        self.add_to_config("smoothed", "use smoothing", bool, False)
+        self.add_to_config("defaultPHbeta", "smoothing beta", float, 0.2)
+        self.add_to_config("defaultPHp", "smoothing p coefficient", float,
+                           0.0)
+
+    def aph_args(self):
+        """ref:config.py:396-430 — APH's dispatch fraction maps to the
+        subproblem window budget (partial solves are the default here)."""
+        self.add_to_config("aph_frac_needed", "fraction dispatched", float,
+                           1.0)
+
+    def fwph_args(self):
+        """ref:config.py:487-520."""
+        self.add_to_config("fwph_iter_limit", "FWPH inner iterations", int,
+                           10)
+        self.add_to_config("fwph_weight", "FWPH weight", float, 0.0)
+        self.add_to_config("fwph_conv_thresh", "FWPH convergence", float,
+                           1e-4)
+
+    def lagrangian_args(self):
+        """ref:config.py:521-538."""
+        self.add_to_config("lagrangian", "use a Lagrangian bound spoke",
+                           bool, False)
+
+    def lagranger_args(self):
+        self.add_to_config("lagranger", "use a Lagranger bound spoke",
+                           bool, False)
+        self.add_to_config("lagranger_rho_rescale_factors_json",
+                           "json {iter: factor}", str, None)
+
+    def subgradient_args(self):
+        self.add_to_config("subgradient", "use a subgradient bound spoke",
+                           bool, False)
+        self.add_to_config("subgradient_rho", "subgradient step rho",
+                           float, 1.0)
+
+    def xhatxbar_args(self):
+        self.add_to_config("xhatxbar", "use an xhat-xbar inner spoke",
+                           bool, False)
+
+    def xhatshuffle_args(self):
+        """ref:config.py:676-699."""
+        self.add_to_config("xhatshuffle", "use an xhat shuffle spoke",
+                           bool, False)
+        self.add_to_config("add_reversed_shuffle", "also reversed order",
+                           bool, False)
+        self.add_to_config("xhatshuffle_iter_step",
+                           "candidates per sync", int, 4)
+
+    def slama_args(self):
+        self.add_to_config("slammax", "use slam-max heuristic spoke", bool,
+                           False)
+        self.add_to_config("slammin", "use slam-min heuristic spoke", bool,
+                           False)
+
+    def converger_args(self):
+        """ref:config.py:897-910."""
+        self.add_to_config("use_primal_dual_converger",
+                           "primal-dual converger", bool, False)
+        self.add_to_config("primal_dual_converger_tol",
+                           "pd converger tolerance", float, 1e-2)
+
+    def tracking_args(self):
+        """ref:config.py:911-949."""
+        self.add_to_config("tracking_folder", "csv trace folder", str,
+                           None)
+
+    def wxbar_read_write_args(self):
+        """ref:config.py:950-975."""
+        self.add_to_config("init_W_fname", "warm-start W file", str, None)
+        self.add_to_config("init_Xbar_fname", "warm-start xbar file", str,
+                           None)
+        self.add_to_config("W_fname", "output W file", str, None)
+        self.add_to_config("Xbar_fname", "output xbar file", str, None)
+
+    def multistage(self):
+        """ref:config.py:315-330."""
+        self.add_to_config("branching_factors",
+                           "branching factors per stage", list, None)
+
+    def mip_options(self):
+        self.add_to_config("iter0_windows",
+                           "PDHG restart windows for iter0", int, 400)
+
+    def checker(self):
+        """Cross-option validation (ref:config.py:143-157)."""
+        if self.get("smoothed") and self.get("defaultPHp", 0.0) < 0:
+            raise ValueError("smoothing needs defaultPHp >= 0")
+
+    # -- argparse bridge (ref:config.py:1014-1048) ------------------------
+    def create_parser(self, progname: str | None = None):
+        parser = argparse.ArgumentParser(prog=progname)
+        for e in self._entries.values():
+            if not e.argparse:
+                continue
+            flag = "--" + e.name.replace("_", "-")
+            if e.domain is bool:
+                parser.add_argument(flag, dest=e.name, nargs="?",
+                                    const=True, default=e.default,
+                                    type=_boolify, help=e.description)
+            elif e.domain is list:
+                parser.add_argument(flag, dest=e.name, nargs="+",
+                                    default=e.default, type=int,
+                                    help=e.description)
+            else:
+                parser.add_argument(flag, dest=e.name, default=e.default,
+                                    type=e.domain or str,
+                                    help=e.description)
+        return parser
+
+    def parse_command_line(self, progname: str | None = None, args=None):
+        parser = self.create_parser(progname)
+        ns = parser.parse_args(args)
+        for k, v in vars(ns).items():
+            if k in self._entries:
+                self._entries[k].value = v
+        return ns

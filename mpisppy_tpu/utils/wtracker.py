@@ -1,0 +1,81 @@
+###############################################################################
+# WTracker: W-oscillation diagnostics over a moving window
+# (ref:mpisppy/utils/wtracker.py:15-253).  Collects the (S, N) W tensor
+# once per PH iteration (one host transfer) and reports per-(scenario,
+# slot) mean/stdev over the last `window` iterations — the reference's
+# wlen/reportlen semantics.
+###############################################################################
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+
+class WTracker:
+    """ref:mpisppy/utils/wtracker.py:15."""
+
+    def __init__(self, ph, window: int = 10):
+        self.ph = ph
+        self.window = int(window)
+        self._hist: collections.deque = collections.deque(maxlen=window)
+
+    def grab_local_Ws(self):
+        """Record this iteration's W (ref:wtracker.py grab_local_Ws)."""
+        self._hist.append(np.asarray(self.ph.state.W))
+
+    def compute_moving_stats(self):
+        """(mean, stdev) arrays of shape (S, N) over the window."""
+        if not self._hist:
+            raise RuntimeError("no W history recorded")
+        stack = np.stack(self._hist)
+        return stack.mean(axis=0), stack.std(axis=0)
+
+    def report_by_moving_stats(self, stdevthresh: float | None = None):
+        """Rows (scenario, slot, mean, stdev) whose stdev exceeds the
+        threshold (ref:wtracker.py report_by_moving_stats)."""
+        mean, std = self.compute_moving_stats()
+        thresh = 0.0 if stdevthresh is None else stdevthresh
+        rows = []
+        for s, i in zip(*np.nonzero(std > thresh)):
+            rows.append((int(s), int(i), float(mean[s, i]),
+                         float(std[s, i])))
+        return rows
+
+    def write_csv(self, fname: str):
+        mean, std = self.compute_moving_stats()
+        with open(fname, "w") as f:
+            f.write("scenario,slot,mean,stdev\n")
+            S, N = mean.shape
+            for s in range(S):
+                for i in range(N):
+                    f.write(f"{s},{i},{mean[s, i]},{std[s, i]}\n")
+
+
+class WTrackerExtension:
+    """Extension wrapper (ref:mpisppy/extensions/wtracker_extension.py:15).
+    Build via functools.partial(WTrackerExtension, window=…) or rely on
+    defaults."""
+
+    def __init__(self, ph, window: int = 10, report_thresh: float = 0.0):
+        self.opt = ph
+        self.tracker = WTracker(ph, window)
+        self.report_thresh = report_thresh
+
+    def pre_iter0(self):
+        pass
+
+    def post_iter0(self):
+        pass
+
+    def miditer(self):
+        pass
+
+    def enditer(self):
+        self.tracker.grab_local_Ws()
+
+    def post_everything(self):
+        from mpisppy_tpu import global_toc
+        rows = self.tracker.report_by_moving_stats(self.report_thresh)
+        global_toc(f"WTracker: {len(rows)} (scenario, slot) pairs above "
+                   f"stdev {self.report_thresh}", False)

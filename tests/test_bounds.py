@@ -93,8 +93,8 @@ def test_xhat_infeasible_candidate(farmer3):
 def test_xhat_shuffle(farmer3, ph_solved):
     x_non = farmer3.nonants(ph_solved.state.solver.x)
     ids = jnp.asarray([0, 1, 2])
-    vals, feas = xhat_mod.xhat_shuffle(farmer3, x_non, ids, 3,
-                                       pdhg.PDHGOptions(tol=1e-6))
+    vals, feas, _ = xhat_mod.xhat_shuffle(farmer3, x_non, ids, 3,
+                                          pdhg.PDHGOptions(tol=1e-6))
     assert bool(feas.all())
     # every candidate evaluation is a valid upper bound (f32 slack)
     assert float(jnp.min(vals)) >= FARMER_EF_OBJ - 2e-3 * abs(FARMER_EF_OBJ)

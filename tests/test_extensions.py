@@ -1,0 +1,189 @@
+# Extension plane + convergers (the TPU analogs of
+# ref:mpisppy/extensions/ and ref:mpisppy/convergers/).
+import functools
+import os
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.convergers import (
+    FractionalConverger, NormRhoConverger, PrimalDualConverger,
+)
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.extensions import MultiExtension
+from mpisppy_tpu.extensions.extension import Extension
+from mpisppy_tpu.extensions.fixer import Fixer
+from mpisppy_tpu.extensions.mipgapper import Gapper
+from mpisppy_tpu.extensions.phtracker import PHTracker
+from mpisppy_tpu.extensions.rho_setters import (
+    CoeffRho, NormRhoUpdater, SepRho,
+)
+from mpisppy_tpu.models import farmer, sslp
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.utils.wtracker import WTracker, WTrackerExtension
+
+OPTS = ph_mod.PHOptions(default_rho=1.0, max_iterations=30,
+                        conv_thresh=1e-3, subproblem_windows=8,
+                        pdhg=pdhg.PDHGOptions(tol=1e-7))
+
+
+def farmer_batch(n=3):
+    specs = [farmer.scenario_creator(nm, num_scens=n)
+             for nm in farmer.scenario_names_creator(n)]
+    return batch_mod.from_specs(specs)
+
+
+def test_hook_call_order():
+    calls = []
+
+    class Probe(Extension):
+        def pre_iter0(self):
+            calls.append("pre_iter0")
+
+        def post_iter0(self):
+            calls.append("post_iter0")
+
+        def miditer(self):
+            calls.append("miditer")
+
+        def enditer(self):
+            calls.append("enditer")
+
+        def post_everything(self):
+            calls.append("post_everything")
+
+    algo = ph_mod.PH(OPTS, farmer_batch(), extensions=Probe)
+    algo.ph_main()
+    assert calls[0] == "pre_iter0"
+    assert calls[1] == "post_iter0"
+    assert calls[-1] == "post_everything"
+    assert "miditer" in calls and "enditer" in calls
+    # miditer precedes enditer within an iteration
+    assert calls.index("miditer") < calls.index("enditer")
+
+
+def test_multi_extension_fans_out():
+    seen = []
+
+    class A(Extension):
+        def enditer(self):
+            seen.append("A")
+
+    class B(Extension):
+        def enditer(self):
+            seen.append("B")
+
+    ext = functools.partial(MultiExtension, ext_classes=[A, B])
+    algo = ph_mod.PH(OPTS, farmer_batch(), extensions=ext)
+    algo.ph_main()
+    assert seen[:2] == ["A", "B"]
+
+
+def test_fixer_fixes_converged_integers():
+    # integer sslp: after PH converges the binary x slots should get
+    # fixed; subsequent solves keep them constant.
+    inst = sslp.synthetic_instance(5, 10, 0)
+    specs = [sslp.scenario_creator(nm, instance=inst, num_scens=4)
+             for nm in sslp.scenario_names_creator(4)]
+    b = batch_mod.from_specs(specs)
+    opts = ph_mod.PHOptions(default_rho=20.0, max_iterations=40,
+                            conv_thresh=0.0, subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7))
+    fixer_holder = {}
+
+    def make_fixer(ph):
+        f = Fixer(ph)
+        f.lag = 3
+        f.tol = 5e-2
+        fixer_holder["f"] = f
+        return f
+
+    algo = ph_mod.PH(opts, b, extensions=make_fixer)
+    algo.ph_main()
+    f = fixer_holder["f"]
+    assert f.nfixed() > 0
+    # fixed slots have collapsed boxes in the live batch
+    cols = np.asarray(algo.batch.nonant_idx)[f.fixed_mask]
+    l = np.asarray(algo.batch.qp.l)[..., cols]
+    u = np.asarray(algo.batch.qp.u)[..., cols]
+    np.testing.assert_allclose(l, u, atol=1e-6)
+
+
+def test_gapper_schedule():
+    sched = {2: 4, 5: 12}
+    algo = ph_mod.PH(OPTS, farmer_batch(),
+                     extensions=functools.partial(Gapper, schedule=sched))
+    algo.ph_main()
+    assert algo.options.subproblem_windows == 12
+
+
+def test_sep_rho_and_coeff_rho():
+    for cls in (SepRho, CoeffRho):
+        algo = ph_mod.PH(OPTS, farmer_batch(), extensions=cls)
+        algo.ph_main()
+        rho = np.asarray(algo.state.rho)
+        assert rho.shape == (algo.batch.num_nonants,)
+        assert (rho > 0).all()
+        # per-variable: costs differ across crops, so rho must too
+        assert rho.std() > 0
+
+
+def test_norm_rho_updater_runs():
+    algo = ph_mod.PH(OPTS, farmer_batch(), extensions=NormRhoUpdater)
+    conv, eobj, _ = algo.ph_main()
+    assert np.isfinite(eobj)
+
+
+def test_wtracker(tmp_path):
+    holder = {}
+
+    def make(ph):
+        e = WTrackerExtension(ph, window=5)
+        holder["e"] = e
+        return e
+
+    algo = ph_mod.PH(OPTS, farmer_batch(), extensions=make)
+    algo.ph_main()
+    tr: WTracker = holder["e"].tracker
+    mean, std = tr.compute_moving_stats()
+    assert mean.shape == (3, algo.batch.num_nonants)
+    fn = tmp_path / "w.csv"
+    tr.write_csv(str(fn))
+    assert fn.exists()
+
+
+def test_phtracker(tmp_path):
+    folder = str(tmp_path / "trk")
+    algo = ph_mod.PH(OPTS, farmer_batch(),
+                     extensions=functools.partial(
+                         PHTracker, folder=folder, track_nonants=True))
+    algo.ph_main()
+    csv = os.path.join(folder, "hub.csv")
+    assert os.path.exists(csv)
+    lines = open(csv).read().strip().splitlines()
+    assert len(lines) >= 2  # header + >=1 iteration
+    assert any(f.endswith(".npz") for f in os.listdir(folder))
+
+
+def test_primal_dual_converger():
+    algo = ph_mod.PH(OPTS, farmer_batch(),
+                     converger=functools.partial(PrimalDualConverger,
+                                                 tol=50.0))
+    algo.ph_main()
+    conv_obj = algo.converger_object
+    assert conv_obj.conv_value is not None
+    assert len(conv_obj.trace) >= 1
+
+
+def test_fractional_converger_continuous_is_trivial():
+    algo = ph_mod.PH(OPTS, farmer_batch(), converger=FractionalConverger)
+    algo.ph_main()
+    # farmer has no integer nonants -> converged immediately at iter 1
+    assert algo._iter == 1
+
+
+def test_norm_rho_converger():
+    algo = ph_mod.PH(OPTS, farmer_batch(), converger=NormRhoConverger)
+    algo.ph_main()
+    assert algo.converger_object.conv_value is not None
