@@ -1,0 +1,318 @@
+###############################################################################
+# Frank-Wolfe Progressive Hedging (FWPH), TPU-native.
+#
+# Reference behavior (ref:mpisppy/fwph/fwph.py:58-307, Boland et al. 2018
+# "Combining Progressive Hedging with a Frank-Wolfe method"): per
+# scenario, maintain a set of *columns* (feasible points of X_s); each
+# outer iteration runs an SDM (simplicial decomposition) inner loop:
+#
+#   1. linearization oracle:  v = argmin_{x in X_s} f_s(x) + What·x_non
+#      with What = W + rho (x_t - xbar)  (the PH objective's gradient at
+#      the current point x_t) — the role the per-scenario MIP solve plays
+#      in the reference (fwph.py:247-257);
+#   2. at inner iteration 0 this oracle IS the Lagrangian subproblem at a
+#      valid multiplier (E_node[What] = 0 because E[x_t] = xbar), so its
+#      dual value yields the TRUE dual bound (fwph.py:264-269);
+#   3. add v to the column set and re-solve the inner QP
+#      min_{lam in Delta} f_s(V'lam) + W·(V'lam)_non
+#                         + rho/2 ||(V'lam)_non - xbar||^2
+#      (fwph.py:282-287 solves this per scenario with Gurobi);
+#   4. Gamma^t = (phi_lin(x_t) - phi_lin(v)) / max(1,|phi_lin(v)|), the
+#      FW gap, drives inner termination (fwph.py:259-276).
+#
+# After the inner loop: xbar <- node_average(x), W += rho (x - xbar) as
+# in PH (fwph.py:186-205).
+#
+# TPU-first re-design — no per-scenario solver objects, no Pyomo
+# expression swapping (fwph.py:994-1051 _swap_nonant_vars exists only
+# because Pyomo objectives are symbolic):
+#   * the column set is a fixed-size ring buffer (S, K, n) with a
+#     validity mask — fixed shapes keep the whole outer iteration one
+#     compiled program;
+#   * the oracle is ONE batched PDHG solve over all scenarios (warm
+#     started across iterations);
+#   * the inner QP is one batched K-dim simplex QP (ops/simplex_qp.py)
+#     with Gram matrices H = V diag(q) V' + V_non diag(rho) V_non'
+#     built by batched matmuls (MXU);
+#   * bound validity is certified from the oracle's dual residuals, as
+#     everywhere else in this framework (no trusting a black-box solver).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg, simplex_qp
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FWPHOptions:
+    """Static options (ref FW_options, ref:mpisppy/utils/config.py
+    fwph_args: fwph_iter_limit / fwph_weight / fwph_conv_thresh)."""
+
+    fw_iter_limit: int = 2       # SDM inner iterations per outer iter
+    fw_weight: float = 0.0       # alpha: linearization point mix
+    fw_conv_thresh: float = 1e-4  # Gamma threshold (masks oracle updates)
+    max_columns: int = 16        # K: column ring-buffer size
+    max_iterations: int = 50     # outer iteration limit
+    conv_thresh: float = 1e-4    # PH-style convergence on ||x - xbar||
+    default_rho: float = 1.0
+    oracle_windows: int = 8      # PDHG restart windows per oracle solve
+    iter0_windows: int = 400
+    qp_iters: int = 300          # FISTA iterations for the simplex QP
+    pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(tol=1e-6)
+    display_progress: bool = False
+    time_limit: float | None = None
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["cols", "valid", "next_slot", "lam", "x", "W", "xbar",
+                 "xbar_nodes", "conv", "rho", "oracle", "bound", "best_bound",
+                 "certified", "gamma"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class FWPHState:
+    cols: Array        # (S, K, n) scaled-space column buffer
+    valid: Array       # (S, K) bool
+    next_slot: Array   # () int32 ring-buffer write cursor (shared)
+    lam: Array         # (S, K) simplex weights
+    x: Array           # (S, n) scaled-space current point V'lam
+    W: Array           # (S, N) duals, original space
+    xbar: Array        # (S, N)
+    xbar_nodes: Array  # (num_nodes, N)
+    conv: Array        # () scaled ||x - xbar||_1
+    rho: Array         # (N,)
+    oracle: pdhg.PDHGState
+    bound: Array       # () last outer iteration's dual bound
+    best_bound: Array  # () max over certified bounds
+    certified: Array   # () bool for `bound`
+    gamma: Array       # (S,) last FW gap per scenario
+
+
+def _phi_parts(batch: ScenarioBatch, W: Array, xbar: Array, rho: Array):
+    """Linear/quadratic coefficients of the PH objective
+    phi(x) = f_s(x) + W·x_non + rho/2 ||x_non - xbar||^2 in scaled space:
+    returns (c_eff (S,n), q_eff (S,n)) with nonant terms scattered in."""
+    lin = W - rho * xbar
+    quad = jnp.broadcast_to(rho, xbar.shape)
+    qp_eff = batch.with_nonant_linear_quad(lin, quad)
+    return qp_eff.c, qp_eff.q
+
+
+def _inner_qp(batch: ScenarioBatch, st: FWPHState):
+    """Build the simplex-QP Gram data from the column buffer.
+
+    phi(V'lam) = 1/2 lam' H lam + g' lam + const with
+      H = V diag(q_eff) V',  g = V c_eff
+    where (c_eff, q_eff) carry f_s + W + prox contributions.
+    """
+    c_eff, q_eff = _phi_parts(batch, st.W, st.xbar, st.rho)
+    S, K, n = st.cols.shape
+    Vq = st.cols * q_eff[:, None, :]
+    H = jnp.einsum("skn,sjn->skj", Vq, st.cols)
+    g = jnp.einsum("skn,sn->sk", st.cols, c_eff)
+    return H, g
+
+
+def _push_column(st: FWPHState, v: Array) -> FWPHState:
+    """Add v to each scenario's column set.
+
+    While the buffer has free slots, fill them in order.  Once full,
+    evict each scenario's LEAST-WEIGHT column (per-scenario argmin of
+    lam) — overwriting in ring order was observed to discard columns
+    still carrying large weight, kicking the QP iterate far from
+    consensus every K/fw_iter_limit outer iterations (the reference
+    never evicts, ref:mpisppy/fwph/fwph.py:309, but an unbounded column
+    set is not an option for a fixed-shape compiled program)."""
+    S, K, _ = st.cols.shape
+    rows = jnp.arange(S)
+    slot = jnp.where(
+        st.next_slot < K,
+        jnp.full((S,), st.next_slot, jnp.int32),
+        jnp.argmin(st.lam, axis=-1).astype(jnp.int32),
+    )
+    cols = st.cols.at[rows, slot].set(v)
+    valid = st.valid.at[rows, slot].set(True)
+    lam = st.lam.at[rows, slot].set(0.0)
+    # renormalize away any (minimal) weight the evicted column carried
+    tot = jnp.maximum(jnp.sum(lam, axis=-1, keepdims=True), 1e-12)
+    return dataclasses.replace(st, cols=cols, valid=valid, lam=lam / tot,
+                               next_slot=st.next_slot + 1)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def fwph_iter(batch: ScenarioBatch, st: FWPHState,
+              opts: FWPHOptions) -> FWPHState:
+    """One FWPH outer iteration (Algorithm 3 lines 4-9 of Boland et al.;
+    ref:mpisppy/fwph/fwph.py:147-307), fully on device."""
+    dt = batch.qp.c.dtype
+    alpha = jnp.asarray(opts.fw_weight, dt)
+    x_non0 = batch.nonants(st.x)
+    xt_non = (1.0 - alpha) * st.xbar + alpha * x_non0
+
+    def sdm_step(t, carry):
+        st, dual0, cert0, x_non_cur = carry
+        x_src = jnp.where(t == 0, xt_non, x_non_cur)
+        What = st.W + st.rho * (x_src - st.xbar)
+        oracle_qp = batch.with_nonant_linear_quad(
+            What, jnp.zeros_like(What))
+        oracle = pdhg.solve_fixed(oracle_qp, opts.oracle_windows, opts.pdhg,
+                                  st.oracle)
+        # dual bound from inner iteration 0 (valid multiplier: see header)
+        dual = boxqp.dual_objective(oracle_qp, oracle.x, oracle.y)
+        _, rd, _ = boxqp.kkt_residuals(oracle_qp, oracle.x, oracle.y)
+        tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(dt).eps)
+        real = batch.p > 0.0
+        cert = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+        dual0 = jnp.where(t == 0, batch.expectation(dual), dual0)
+        cert0 = jnp.where(t == 0, cert, cert0)
+
+        # Gamma^t: linearized-objective gap between current point and
+        # vertex (ref:fwph.py:259-276). phi_lin(x) = f_s(x) + What·x_non.
+        v = oracle.x
+        c_lin, q_lin = _phi_parts(batch, What,
+                                  jnp.zeros_like(st.xbar),
+                                  jnp.zeros_like(st.rho))
+        def phi_lin(xs):
+            return jnp.sum(c_lin * xs + 0.5 * q_lin * xs * xs, axis=-1)
+        val_v = phi_lin(v)
+        val_x = phi_lin(st.x)
+        gamma = (val_x - val_v) / jnp.maximum(1.0, jnp.abs(val_v))
+
+        st = dataclasses.replace(st, oracle=oracle)
+        st = _push_column(st, v)
+        H, g = _inner_qp(batch, st)
+        lam = simplex_qp.solve_simplex_qp(H, g, st.valid, st.lam,
+                                          iters=opts.qp_iters)
+        x = jnp.einsum("sk,skn->sn", lam, st.cols)
+        st = dataclasses.replace(st, lam=lam, x=x, gamma=gamma)
+        return st, dual0, cert0, batch.nonants(x)
+
+    init = (st, jnp.asarray(-jnp.inf, dt), jnp.asarray(False), x_non0)
+    st, dual0, cert0, x_non = jax.lax.fori_loop(
+        0, opts.fw_iter_limit, sdm_step, init)
+
+    # outer updates: xbar, conv, W (ref:fwph.py:186-205 + phbase analogs)
+    xbar, xbar_nodes = batch.node_average(x_non)
+    conv = batch.expectation(
+        jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / batch.num_nonants
+    W = st.W + st.rho * (x_non - xbar)
+    best = jnp.where(cert0, jnp.maximum(st.best_bound, dual0), st.best_bound)
+    return dataclasses.replace(st, xbar=xbar, xbar_nodes=xbar_nodes,
+                               conv=conv, W=W, bound=dual0,
+                               best_bound=best, certified=cert0)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def fwph_init(batch: ScenarioBatch, rho: Array, opts: FWPHOptions):
+    """fw_prep (ref:mpisppy/fwph/fwph.py:97-145): Iter0-style cold solves
+    seed the first column, xbar, and W; the trivial bound comes from the
+    dual side with a certificate (same recipe as algos/ph.ph_iter0)."""
+    dt = batch.qp.c.dtype
+    S, N = batch.num_scenarios, batch.num_nonants
+    n = batch.qp.c.shape[-1]
+    K = opts.max_columns
+
+    st0 = pdhg.init_state(batch.qp, opts.pdhg)
+    solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
+    dual = boxqp.dual_objective(batch.qp, solver.x, solver.y)
+    _, rd, _ = boxqp.kkt_residuals(batch.qp, solver.x, solver.y)
+    tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(dt).eps)
+    real = batch.p > 0.0
+    cert = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    trivial = batch.expectation(dual)
+
+    x = solver.x
+    x_non = batch.nonants(x)
+    xbar, xbar_nodes = batch.node_average(x_non)
+    W = rho * (x_non - xbar)
+    conv = batch.expectation(
+        jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / N
+
+    cols = jnp.zeros((S, K, n), dt).at[:, 0, :].set(x)
+    valid = jnp.zeros((S, K), bool).at[:, 0].set(True)
+    lam = jnp.zeros((S, K), dt).at[:, 0].set(1.0)
+
+    st = FWPHState(
+        cols=cols, valid=valid, next_slot=jnp.asarray(1, jnp.int32),
+        lam=lam, x=x, W=W, xbar=xbar, xbar_nodes=xbar_nodes, conv=conv,
+        rho=rho, oracle=solver,
+        bound=trivial, best_bound=jnp.where(cert, trivial,
+                                            jnp.asarray(-jnp.inf, dt)),
+        certified=cert, gamma=jnp.full((S,), jnp.inf, dt),
+    )
+    return st, trivial, cert
+
+
+class FWPH:
+    """Host-side FWPH driver (ref:mpisppy/fwph/fwph.py:147-212).
+
+    fwph_main() returns (iters, weight_dict, xbar_dict) like the
+    reference; the dual bound history is exposed via .best_bound /
+    ._local_bound for the spoke layer.
+    """
+
+    def __init__(self, options: FWPHOptions, batch: ScenarioBatch,
+                 scenario_names=None, rho: Array | float | None = None):
+        self.options = options
+        self.batch = batch
+        self.scenario_names = scenario_names or [
+            f"scen{i}" for i in range(batch.num_real)]
+        if rho is None:
+            rho = options.default_rho
+        self.rho = jnp.broadcast_to(
+            jnp.asarray(rho, batch.qp.c.dtype), (batch.num_nonants,))
+        self.spcomm = None
+        self.state: FWPHState | None = None
+        self.trivial_bound: float | None = None
+        self._local_bound: float = -np.inf
+        self.best_bound: float = -np.inf
+        self._iter = 0
+
+    def fw_prep(self) -> float:
+        self.state, tb, cert = fwph_init(self.batch, self.rho, self.options)
+        self.trivial_bound = float(tb)
+        if bool(cert):
+            self.best_bound = self.trivial_bound
+        global_toc(f"FWPH prep: trivial bound = {self.trivial_bound:.6g}",
+                   self.options.display_progress)
+        return self.trivial_bound
+
+    def fwph_main(self):
+        import time
+        t0 = time.time()
+        self.fw_prep()
+        itr = 0
+        for itr in range(1, self.options.max_iterations + 1):
+            self._iter = itr
+            self.state = fwph_iter(self.batch, self.state, self.options)
+            self._local_bound = float(self.state.bound)
+            self.best_bound = float(self.state.best_bound)
+            conv = float(self.state.conv)
+            global_toc(
+                f"FWPH iter {itr}: bound={self._local_bound:.6g} "
+                f"best={self.best_bound:.6g} conv={conv:.3e}",
+                self.options.display_progress)
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    break
+            if conv <= self.options.conv_thresh:
+                break
+            if (self.options.time_limit is not None
+                    and time.time() - t0 > self.options.time_limit):
+                break
+        weights = {nm: np.asarray(self.state.lam[i])
+                   for i, nm in enumerate(self.scenario_names)}
+        xbars = np.asarray(self.state.xbar_nodes)
+        return itr, weights, xbars

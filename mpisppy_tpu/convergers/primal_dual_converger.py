@@ -18,10 +18,9 @@ from mpisppy_tpu.convergers.converger import Converger
 class PrimalDualConverger(Converger):
     """ref:mpisppy/convergers/primal_dual_converger.py:17."""
 
-    def __init__(self, opt, tol: float | None = None):
+    def __init__(self, opt, tol: float = 1e-2):
         super().__init__(opt)
-        self.tol = float(tol if tol is not None
-                         else getattr(opt, "primal_dual_tol", 1e-2))
+        self.tol = float(tol)
         self._prev_xbar = None
         self.trace: list[tuple[float, float]] = []
 

@@ -182,6 +182,38 @@ class SubgradientOuterBound(OuterBoundSpoke):
         return self.bound
 
 
+class FWPHOuterBound(OuterBoundSpoke):
+    """FWPH as an outer-bound spoke (ref:cylinders/fwph_spoke.py:11-39):
+    self-contained — advances one FWPH outer iteration per hub sync and
+    publishes the certified dual bound (`opt._local_bound` analog)."""
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        from mpisppy_tpu.algos import fwph as fwph_mod
+        self._fwph_mod = fwph_mod
+        self.fw_opts = self.options.get("fw_opts", fwph_mod.FWPHOptions())
+        rho = jnp.broadcast_to(
+            jnp.asarray(float(self.options.get("rho",
+                                               self.fw_opts.default_rho)),
+                        self.batch.qp.c.dtype),
+            (self.batch.num_nonants,))
+        self._st, _, _ = fwph_mod.fwph_init(self.batch, rho, self.fw_opts)
+
+    def update(self, hub_payload):
+        self._st = self._fwph_mod.fwph_iter(self.batch, self._st,
+                                            self.fw_opts)
+        self._pending = self._st
+
+    def harvest(self):
+        if self._pending is None:
+            return None
+        st = self._pending
+        b = float(st.best_bound)
+        if np.isfinite(b) and (self.bound is None or b > self.bound):
+            self.bound = b
+        return self.bound
+
+
 # ---------------------------------------------------------------------------
 # Inner bounds (incumbent finders)
 # ---------------------------------------------------------------------------
@@ -211,14 +243,22 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
     def __init__(self, opt, options=None):
         super().__init__(opt, options)
         self.k = int(self.options.get("k", 4))
+        # reverse epochs: walk the shuffle backwards every other pass
+        # (ref:xhatshufflelooper_bounder.py ScenarioCycler reverse mode)
+        self.add_reversed = bool(self.options.get("add_reversed", False))
         rng = np.random.default_rng(self.options.get("seed", 42))
         self._order = rng.permutation(self.batch.num_real)
         self._cursor = 0
+        self._reversed_epoch = False
 
     def _next_ids(self):
-        ids = [int(self._order[(self._cursor + j) % self.batch.num_real])
-               for j in range(self.k)]
-        self._cursor = (self._cursor + self.k) % self.batch.num_real
+        S = self.batch.num_real
+        order = self._order[::-1] if self._reversed_epoch else self._order
+        ids = [int(order[(self._cursor + j) % S]) for j in range(self.k)]
+        cursor = self._cursor + self.k
+        if cursor >= S and self.add_reversed:
+            self._reversed_epoch = not self._reversed_epoch
+        self._cursor = cursor % S
         return jnp.asarray(ids)
 
     def update(self, hub_payload):

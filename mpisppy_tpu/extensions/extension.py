@@ -82,7 +82,7 @@ class MultiExtension(Extension):
 for _hook in ["pre_iter0", "iter0_post_solver_creation", "post_iter0",
               "post_iter0_after_sync", "miditer", "enditer",
               "enditer_after_sync", "post_everything", "pre_solve_loop",
-              "post_solve_loop", "setup_hub",
+              "post_solve_loop", "pre_solve", "post_solve", "setup_hub",
               "initialize_spoke_indices", "sync_with_spokes"]:
     def _make(h):
         def f(self, *args):

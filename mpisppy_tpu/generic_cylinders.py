@@ -34,10 +34,15 @@ def _parse_args(module, args=None):
     cfg.add_to_config("solution_base_name",
                       "write first-stage solution files with this base",
                       str, None)
+    # the model module declares its flags FIRST: add_to_config ignores
+    # re-declaration, so a module's defaults (e.g. hydro's
+    # branching_factors=[3,3]) win over the canned groups' None defaults
+    module.inparser_adder(cfg)
     cfg.num_scens_optional()
     cfg.popular_args()
     cfg.ph_args()
     cfg.two_sided_args()
+    cfg.fwph_args()
     cfg.lagrangian_args()
     cfg.lagranger_args()
     cfg.subgradient_args()
@@ -47,7 +52,6 @@ def _parse_args(module, args=None):
     cfg.converger_args()
     cfg.wxbar_read_write_args()
     cfg.multistage()
-    module.inparser_adder(cfg)
     cfg.parse_command_line("mpisppy_tpu.generic_cylinders", args)
     cfg.checker()
     return cfg
@@ -104,8 +108,20 @@ def _do_EF(cfg, module):
 def _do_decomp(cfg, module):
     """ref:generic_cylinders.py:109-312."""
     batch, names, specs = _build_batch(cfg, module)
-    hub = vanilla.ph_hub(cfg, batch, scenario_names=names)
+    converger = None
+    if cfg.get("use_primal_dual_converger"):
+        import functools
+        from mpisppy_tpu.convergers.primal_dual_converger import (
+            PrimalDualConverger,
+        )
+        converger = functools.partial(
+            PrimalDualConverger,
+            tol=cfg.get("primal_dual_converger_tol", 1e-2))
+    hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
+                         converger=converger)
     spokes = []
+    if cfg.get("fwph"):
+        spokes.append(vanilla.fwph_spoke(cfg))
     if cfg.get("lagrangian"):
         spokes.append(vanilla.lagrangian_spoke(cfg))
     if cfg.get("lagranger"):

@@ -25,11 +25,10 @@
 ###############################################################################
 from __future__ import annotations
 
-import re
-
 import numpy as np
 
 from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.utils.sputils import extract_num  # noqa: F401 (re-export)
 
 _BASE_YIELD = np.array([
     [2.0, 2.4, 16.0],   # BelowAverageScenario
@@ -42,12 +41,6 @@ _SUPER_PRICE = np.array([0.0, 0.0, 10.0])
 _PURCHASE_PRICE = np.array([238.0, 210.0, 100000.0])
 _CATTLE_FEED = np.array([200.0, 240.0, 0.0])
 _PRICE_QUOTA = np.array([100000.0, 100000.0, 6000.0])
-
-
-def extract_num(name: str) -> int:
-    """Digits scraped off the right of a scenario name
-    (ref:mpisppy/utils/sputils.py analog used by farmer)."""
-    return int(re.compile(r"(\d+)$").search(name).group(1))
 
 
 def _yields(scennum: int, crops_multiplier: int, seedoffset: int) -> np.ndarray:

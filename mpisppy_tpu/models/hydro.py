@@ -30,12 +30,11 @@
 ###############################################################################
 from __future__ import annotations
 
-import re
-
 import numpy as np
 
 from mpisppy_tpu.core.batch import ScenarioSpec
 from mpisppy_tpu.core.tree import ScenarioTree
+from mpisppy_tpu.utils.sputils import extract_num  # noqa: F401 (re-export)
 
 _D = np.array([90.0, 160.0, 110.0])
 _U = np.array([0.6048, 0.6048, 1.2096])
@@ -51,10 +50,6 @@ _FCFE = 4166.67
 _A1 = 50.0
 _A2_BASE = np.array([10.0, 50.0, 90.0])   # ref:PySP/nodedata/Node2_*.dat
 _A3_BASE = np.array([40.0, 50.0, 60.0])   # ref:PySP/nodedata/Node3_*_*.dat
-
-
-def extract_num(name: str) -> int:
-    return int(re.compile(r"(\d+)$").search(name).group(1))
 
 
 def _inflow(base: np.ndarray, branch: int, seed_tag: int) -> float:

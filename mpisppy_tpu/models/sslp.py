@@ -39,8 +39,13 @@ import re
 import numpy as np
 
 from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.utils.sputils import extract_num  # noqa: F401 (re-export)
 
 DEFAULT_PENALTY = 1000.0
+
+# data_dir -> the first parsed scenario's dict, reused as the shared
+# deterministic-instance carrier for _build_spec's cache (see below)
+_DATA_DIR_CACHE: dict[str, dict] = {}
 
 
 # --------------------------------------------------------------------------
@@ -122,10 +127,6 @@ def synthetic_client_present(n_clients: int, scennum: int,
     """ClientPresent ~ Bernoulli(1/2) per client, seeded per scenario."""
     rng = np.random.RandomState(10_000 + scennum + seedoffset)
     return (rng.rand(n_clients) < 0.5).astype(float)
-
-
-def extract_num(name: str) -> int:
-    return int(re.compile(r"(\d+)$").search(name).group(1))
 
 
 # --------------------------------------------------------------------------
@@ -214,7 +215,12 @@ def scenario_creator(scenario_name: str, data_dir: str | None = None,
             h[:cp.shape[0]] = cp
         else:
             h[:] = 1.0  # AMPL default=1 (ReferenceModel.py ClientPresent)
-        inst = data
+        # The deterministic data repeats in every ScenarioK.dat — route
+        # all scenarios of a directory through ONE cached inst dict so
+        # _build_spec's shared-(A,c,…) cache actually hits and the batch
+        # compiler sees identical array objects (one (m,n) A on the host
+        # regardless of scenario count).
+        inst = _DATA_DIR_CACHE.setdefault(data_dir, data)
     else:
         if instance is None:
             instance = synthetic_instance(n_servers, n_clients, inst_seed)

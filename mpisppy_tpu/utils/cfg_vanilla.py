@@ -95,6 +95,22 @@ def subgradient_spoke(cfg) -> dict:
                                   cfg.get("default_rho", 1.0))})
 
 
+def fwph_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:328-435."""
+    from mpisppy_tpu.algos import fwph as fwph_mod
+    fw_opts = fwph_mod.FWPHOptions(
+        fw_iter_limit=cfg.get("fwph_iter_limit", 2),
+        fw_weight=cfg.get("fwph_weight", 0.0),
+        fw_conv_thresh=cfg.get("fwph_conv_thresh", 1e-4),
+        max_columns=cfg.get("fwph_max_columns", 16),
+        default_rho=cfg.get("default_rho", 1.0),
+        pdhg=_pdhg_opts(cfg),
+    )
+    return _spoke(spoke_mod.FWPHOuterBound,
+                  {"pdhg_opts": _pdhg_opts(cfg), "fw_opts": fw_opts,
+                   "rho": cfg.get("default_rho", 1.0)})
+
+
 def xhatxbar_spoke(cfg) -> dict:
     """ref:cfg_vanilla.py:589-621."""
     return _spoke(spoke_mod.XhatXbarInnerBound,
@@ -105,7 +121,8 @@ def xhatshuffle_spoke(cfg) -> dict:
     """ref:cfg_vanilla.py:622-655."""
     return _spoke(spoke_mod.XhatShuffleInnerBound,
                   {"pdhg_opts": _pdhg_opts(cfg),
-                   "k": cfg.get("xhatshuffle_iter_step", 4)})
+                   "k": cfg.get("xhatshuffle_iter_step", 4),
+                   "add_reversed": cfg.get("add_reversed_shuffle", False)})
 
 
 def slammax_spoke(cfg) -> dict:

@@ -143,8 +143,12 @@ class Config:
 
     def fwph_args(self):
         """ref:config.py:487-520."""
+        self.add_to_config("fwph", "use an FWPH outer-bound spoke", bool,
+                           False)
         self.add_to_config("fwph_iter_limit", "FWPH inner iterations", int,
-                           10)
+                           2)
+        self.add_to_config("fwph_max_columns", "FWPH column-buffer size",
+                           int, 16)
         self.add_to_config("fwph_weight", "FWPH weight", float, 0.0)
         self.add_to_config("fwph_conv_thresh", "FWPH convergence", float,
                            1e-4)

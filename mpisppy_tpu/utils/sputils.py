@@ -1,0 +1,20 @@
+###############################################################################
+# Small shared scenario utilities (the analog of the reference's
+# ref:mpisppy/utils/sputils.py grab-bag; most of that file's roles —
+# EF building, tree parsing, writers — live in core/tree.py, algos/ef.py
+# and the drivers here, so only the genuinely shared helpers remain).
+###############################################################################
+from __future__ import annotations
+
+import re
+
+_TRAILING_DIGITS = re.compile(r"(\d+)$")
+
+
+def extract_num(name: str) -> int:
+    """Digits scraped off the right of a scenario name
+    (ref:mpisppy/utils/sputils.py:632-689 scenario-number parsing)."""
+    m = _TRAILING_DIGITS.search(name)
+    if m is None:
+        raise ValueError(f"scenario name {name!r} has no trailing number")
+    return int(m.group(1))

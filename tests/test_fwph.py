@@ -1,0 +1,103 @@
+# FWPH: SDM column generation + true Lagrangian dual bounds.
+# Oracle: farmer 3-scenario EF objective -108390 (scipy-verified in
+# test_farmer_ef_ph.py).  For an LP the FWPH dual bound must converge to
+# the EF objective from below while remaining a valid outer bound.
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import fwph as fwph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg, simplex_qp
+
+FARMER_EF_OBJ = -108390.0
+
+
+@pytest.fixture(scope="module")
+def farmer3():
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    return batch_mod.from_specs(specs)
+
+
+def test_project_simplex_basic():
+    v = jnp.asarray([[0.3, 0.9, -0.1, 5.0]])
+    valid = jnp.asarray([[True, True, True, False]])
+    lam = simplex_qp.project_simplex(v, valid)
+    assert np.isclose(float(jnp.sum(lam)), 1.0, atol=1e-6)
+    assert float(lam[0, 3]) == 0.0  # invalid column excluded
+    assert np.all(np.asarray(lam) >= 0)
+    # already-feasible point projects to itself
+    v2 = jnp.asarray([[0.25, 0.75, 0.0, 0.0]])
+    lam2 = simplex_qp.project_simplex(v2, jnp.asarray([[True] * 4]))
+    assert np.allclose(np.asarray(lam2), np.asarray(v2), atol=1e-6)
+
+
+def test_simplex_qp_known_answer():
+    """min 1/2||lam - t||^2 over the simplex == projection of t."""
+    K = 5
+    H = jnp.eye(K)[None]
+    t = jnp.asarray([[0.4, 0.4, 0.1, 0.05, 0.05]])
+    g = -t
+    valid = jnp.ones((1, K), bool)
+    lam = simplex_qp.solve_simplex_qp(H, g, valid, iters=300)
+    assert np.allclose(np.asarray(lam), np.asarray(t), atol=1e-4)
+    # masked variant: restrict to first 2 columns
+    valid2 = jnp.asarray([[True, True, False, False, False]])
+    lam2 = simplex_qp.solve_simplex_qp(H, g, valid2, iters=300)
+    assert np.allclose(np.asarray(lam2[0, 2:]), 0.0)
+    assert np.allclose(np.asarray(lam2[0, :2]), 0.5, atol=1e-4)
+
+
+def test_fwph_bound_converges_to_ef(farmer3):
+    """FWPH dual bounds: valid (<= EF obj) and converging to it."""
+    opts = fwph_mod.FWPHOptions(
+        fw_iter_limit=2, max_columns=16, max_iterations=40,
+        conv_thresh=1e-3, oracle_windows=12,
+        pdhg=pdhg.PDHGOptions(tol=1e-7))
+    algo = fwph_mod.FWPH(opts, farmer3)
+    itr, weights, xbars = algo.fwph_main()
+
+    # every certified bound is a valid outer bound
+    assert algo.best_bound <= FARMER_EF_OBJ + 5.0
+    # and FWPH converges the bound to the EF objective (LP: no gap)
+    assert algo.best_bound == pytest.approx(FARMER_EF_OBJ, rel=2e-3)
+    # trivial bound (wait-and-see) is looser than the converged bound
+    assert algo.trivial_bound <= algo.best_bound + 1.0
+
+    # the QP iterate is a convex combination: weights on the simplex
+    for lam in weights.values():
+        assert np.isclose(lam.sum(), 1.0, atol=1e-4)
+        assert (lam >= -1e-6).all()
+
+    # primal consensus: xbar from the QP iterates near the EF solution
+    assert np.isfinite(xbars).all()
+
+
+def test_fwph_spoke_in_wheel(farmer3):
+    """FWPH as an outer-bound spoke under the PH hub tightens the gap."""
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders.hub import PHHub
+    from mpisppy_tpu.cylinders.spoke import FWPHOuterBound
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    ph_opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=30,
+                               conv_thresh=1e-4, subproblem_windows=10,
+                               pdhg=pdhg.PDHGOptions(tol=1e-7))
+    fw_opts = fwph_mod.FWPHOptions(
+        fw_iter_limit=2, max_columns=16, oracle_windows=12,
+        pdhg=pdhg.PDHGOptions(tol=1e-7))
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.005}},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_opts, "batch": farmer3},
+    }
+    spoke = {"spoke_class": FWPHOuterBound,
+             "opt_kwargs": {"options": {"fw_opts": fw_opts}}}
+    wheel = WheelSpinner(hub_dict, [spoke])
+    wheel.spin()
+    assert wheel.BestOuterBound is not None
+    assert wheel.BestOuterBound <= FARMER_EF_OBJ + 5.0
+    assert wheel.BestOuterBound >= FARMER_EF_OBJ - 0.05 * abs(FARMER_EF_OBJ)
