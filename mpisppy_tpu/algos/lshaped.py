@@ -1,0 +1,350 @@
+###############################################################################
+# L-shaped (Benders) decomposition, TPU-native.
+#
+# The reference (ref:mpisppy/opt/lshaped.py:29-783) builds a Pyomo root
+# problem plus per-scenario subproblems and iterates master solve +
+# sequential per-rank cut generation through Pyomo's Benders generator
+# (ref:mpisppy/utils/lshaped_cuts.py:34, dual sign conventions at
+# :19-32).  Two-stage, min problems only — same restriction here.
+#
+# TPU-native design:
+#   * ALL scenario subproblems (first stage fixed at the master's x̂) are
+#     ONE batched PDHG solve — cut generation is a single tensor program,
+#     not a loop over CPU solver calls.
+#   * Optimality cuts come from the DUAL side: for any dual iterate
+#     (x, y) of the fixed-nonant subproblem, the Fenchel bound
+#     D(x, y; x̂') is affine in x̂' with slope = the nonant reduced cost,
+#     so  phi_s(x̂') >= alpha_s + g_s·x̂'  is valid even for INEXACT
+#     subproblem solves (the reference needs exact LP duals from Gurobi;
+#     a first-order kernel gets validity for free from weak duality).
+#   * Feasibility cuts come from the kernel's Farkas certificates
+#     (ops/boxqp.infeasibility_certificate): the certificate value is
+#     affine in x̂ through the collapsed nonant box, giving the exact
+#     analog of the reference's feasibility cuts.
+#   * The master is a small BoxQP over [x_nonant; eta] with a
+#     fixed-capacity cut buffer (static shapes => one compiled master
+#     solve reused every iteration).  Single-cut (aggregated, classic
+#     L-shaped) or multi-cut (per-scenario eta_s, faster on few
+#     scenarios) — ref's root_solver options analog.
+#
+# Requires zero quadratic cost on the first-stage (nonant) columns: the
+# dual bound is then exactly affine in x̂.  (The reference's L-shaped is
+# LP-only, so this is a strict superset: second-stage diagonal quadratics
+# are fine.)
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg
+from mpisppy_tpu.ops.boxqp import BoxQP
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class LShapedOptions:
+    """Static options (ref:mpisppy/opt/lshaped.py options dict:
+    max_iter, tol, root_solver, valid_eta_lb)."""
+
+    max_iter: int = 50
+    tol: float = 1e-4              # relative ub-lb gap
+    multicut: bool = False         # per-scenario eta (ref multi-cut mode)
+    max_cuts: int = 256            # master cut-buffer capacity (rows)
+    eta_lb: float | None = None    # valid lower bound on E[cost]; default:
+    #                                wait-and-see dual bound - margin
+    sub_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
+        tol=1e-7, max_iters=100_000, detect_infeas=True)
+    master_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
+        tol=1e-7, max_iters=200_000)
+    feas_tol: float = 1e-4         # primal-residual gate for ub validity
+    display_progress: bool = False
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _subproblem_cuts(batch: ScenarioBatch, xhat: Array,
+                     opts: pdhg.PDHGOptions):
+    """Solve every scenario with nonants fixed at x̂ and extract, per
+    scenario: the dual (outer) value, the optimality-cut slope, the
+    primal objective + residual (inner-bound material), the status mask,
+    and Farkas feasibility-cut pieces from two candidate rays.
+
+    This one call replaces the reference's per-scenario subproblem loop
+    + cut generator (ref:mpisppy/opt/lshaped.py:387-513)."""
+    qp = batch.with_fixed_nonants(xhat)
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+
+    # Optimality cut: D(x,y; x̂') = const + rc_non·(x̂'/d_non) for fixed
+    # (x, y) — valid lower bound on phi_s(x̂') by weak duality (PDLP-form
+    # dual, ops/boxqp.dual_objective).  g is the ORIGINAL-space slope.
+    dual = boxqp.dual_objective(qp, st.x, st.y)
+    rc = qp.c + qp.q * st.x + qp.rmatvec(st.y)
+    g = rc[..., batch.nonant_idx] / batch.d_non          # (S, N)
+    alpha = dual - jnp.sum(g * xhat, axis=-1)            # (S,)
+
+    obj = boxqp.objective(qp, st.x)
+    rp, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+
+    def farkas_affine(y):
+        """(qval, const, gf): certificate value at x̂, and its affine
+        form qval(x̂') = const + gf·x̂' (must be <= 0 for feasibility)."""
+        nrm = jnp.sum(jnp.abs(y), axis=-1, keepdims=True)
+        yn = y / jnp.maximum(nrm, 1e-30)
+        z = qp.rmatvec(yn)
+        ztol = 32.0 * jnp.finfo(z.dtype).eps
+        z = jnp.where(jnp.abs(z) <= ztol, 0.0, z)
+        inf_j = jnp.where(z > 0.0, z * qp.l, z * qp.u)
+        inf_j = jnp.where(z == 0.0, 0.0, inf_j)
+        sup_i = jnp.where(yn > 0.0, yn * qp.bu, yn * qp.bl)
+        sup_i = jnp.where(yn == 0.0, 0.0, sup_i)
+        bad = (~jnp.isfinite(inf_j)).any(axis=-1) \
+            | (~jnp.isfinite(sup_i)).any(axis=-1)
+        qval = jnp.sum(inf_j, axis=-1) - jnp.sum(sup_i, axis=-1)
+        gf = z[..., batch.nonant_idx] / batch.d_non
+        const = qval - jnp.sum(gf * xhat, axis=-1)
+        qval = jnp.where(bad, -jnp.inf, qval)
+        return qval, const, gf
+
+    # candidate rays: per-window displacement and the raw dual iterate
+    # (mirrors ops/pdhg._restart's detection candidates)
+    q1, c1, g1 = farkas_affine(st.y - st.y_anchor)
+    q2, c2, g2 = farkas_affine(st.y)
+    take2 = (q2 > q1)[..., None]
+    feas_qval = jnp.maximum(q1, q2)
+    feas_const = jnp.where(take2[..., 0], c2, c1)
+    feas_g = jnp.where(take2, g2, g1)
+
+    return dict(dual=dual, alpha=alpha, g=g, obj=obj, rp=rp, rd=rd,
+                status=st.status, feas_qval=feas_qval,
+                feas_const=feas_const, feas_g=feas_g)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _master_solve(qp: BoxQP, opts: pdhg.PDHGOptions):
+    """Solve the master and return (x, value, certified lower bound,
+    dual residual, done)."""
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    val = boxqp.objective(qp, st.x)
+    lb = boxqp.dual_objective(qp, st.x, st.y)
+    _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    return st.x, val, lb, rd, st.done
+
+
+class LShapedMethod:
+    """Host-side Benders driver (ref:mpisppy/opt/lshaped.py:29,515).
+
+    Usage matches the reference shape:
+        ls = LShapedMethod(options, batch)
+        result = ls.lshaped_algorithm()
+    """
+
+    def __init__(self, options: LShapedOptions | dict,
+                 batch: ScenarioBatch, scenario_names=None):
+        if isinstance(options, dict):
+            options = LShapedOptions(**options)
+        self.options = options
+        self.batch = batch
+        self.scenario_names = scenario_names
+        if batch.tree.num_nodes != 1:
+            raise ValueError("LShaped is two-stage only "
+                             "(ref:mpisppy/opt/lshaped.py:29 docstring)")
+        qnon = np.asarray(batch.qp.q)[..., np.asarray(batch.nonant_idx)]
+        if np.abs(qnon).max() > 0.0:
+            raise ValueError("LShaped requires linear first-stage cost "
+                             "(quadratic nonant cost breaks cut affinity)")
+        self._setup_master_box()
+        # results
+        self.xhat: np.ndarray | None = None
+        self.lb = -np.inf
+        self.ub = np.inf
+        self.iterations = 0
+        self.trace: list[dict] = []
+        self.spcomm = None  # cylinder seam (ref:lshaped.py spcomm hooks)
+
+    # -- master construction ----------------------------------------------
+    def _setup_master_box(self):
+        """First-stage box in original space: the tightest intersection
+        across scenarios (they coincide for well-posed models)."""
+        b = self.batch
+        n_idx = np.asarray(b.nonant_idx)
+        S = b.num_scenarios
+        l_s = np.broadcast_to(np.asarray(b.qp.l), (S, b.qp.n))[:, n_idx]
+        u_s = np.broadcast_to(np.asarray(b.qp.u), (S, b.qp.n))[:, n_idx]
+        d = np.broadcast_to(np.asarray(b.d_non), (S, len(n_idx)))
+        self._x_l = np.max(l_s * d, axis=0)
+        self._x_u = np.min(u_s * d, axis=0)
+        self._N = len(n_idx)
+        self._p = np.asarray(b.p, np.float64)
+
+    def _master_qp(self, cuts_A, cuts_bl, cuts_bu, eta_lb) -> BoxQP:
+        """Master BoxQP over [x (N); eta (1 or S)] with the cut buffer.
+
+        Scaled with Ruiz at every (re)build — cut coefficients mix cost
+        magnitudes (1e2) with value magnitudes (1e5), which stalls an
+        unscaled first-order solve."""
+        N = self._N
+        n_eta = self.batch.num_scenarios if self.options.multicut else 1
+        n = N + n_eta
+        c = np.zeros(n)
+        if self.options.multicut:
+            c[N:] = self._p
+        else:
+            c[N] = 1.0
+        eta_lb = np.broadcast_to(np.asarray(eta_lb, np.float64), (n_eta,))
+        l = np.concatenate([self._x_l, eta_lb])
+        u = np.concatenate([self._x_u, np.full(n_eta, np.inf)])
+        qp = boxqp.make_boxqp(c, cuts_A, cuts_bl, cuts_bu, l, u,
+                              dtype=self.batch.qp.c.dtype)
+        qp, scaling = boxqp.ruiz_scale(qp)
+        return qp, scaling
+
+    # -- the algorithm -----------------------------------------------------
+    def lshaped_algorithm(self) -> dict:
+        """ref:mpisppy/opt/lshaped.py:515 lshaped_algorithm()."""
+        opts = self.options
+        b = self.batch
+        N = self._N
+        n_eta = b.num_scenarios if opts.multicut else 1
+        ncols = N + n_eta
+        real = self._p > 0.0
+
+        # Iter 0: unrestricted scenario solves give the wait-and-see
+        # bound (default eta_lb) and the initial x̂ = E[x_non]
+        # (ref:lshaped.py attaches scenarios to the root for the same
+        # effect; here it is one batched solve).
+        st0 = pdhg.solve(b.qp, opts.sub_pdhg,
+                         pdhg.init_state(b.qp, opts.sub_pdhg))
+        ws_dual = boxqp.dual_objective(b.qp, st0.x, st0.y)
+        ws = float(b.expectation(ws_dual))
+        if opts.eta_lb is not None:
+            eta_lb = opts.eta_lb
+        elif opts.multicut:
+            # per-scenario eta_s needs a PER-SCENARIO valid lower bound:
+            # the expectation is NOT below every scenario's own value
+            wsd = np.asarray(ws_dual, np.float64)
+            eta_lb = wsd - 0.05 * np.abs(wsd) - 1.0
+            eta_lb[~real] = 0.0  # padded scenarios: p=0, keep bounded
+        else:
+            eta_lb = ws - 0.05 * abs(ws) - 1.0
+        x_non0 = b.nonants(st0.x)
+        xhat = np.asarray(jnp.sum(b.p[:, None] * x_non0, axis=0), np.float64)
+        xhat = np.clip(xhat, self._x_l, self._x_u)
+
+        # host-side master cut buffer (float64; static device shapes)
+        cuts_A = np.zeros((opts.max_cuts, ncols))
+        cuts_bl = np.full(opts.max_cuts, -np.inf)
+        cuts_bu = np.full(opts.max_cuts, np.inf)
+        ncuts = 0
+
+        def add_row(row, bl=-np.inf, bu=np.inf):
+            nonlocal ncuts
+            if ncuts >= opts.max_cuts:
+                # overwrite the oldest cut (simple ring; the reference
+                # keeps all cuts — capacity is a device-shape tradeoff)
+                idx = ncuts % opts.max_cuts
+            else:
+                idx = ncuts
+            cuts_A[idx] = row
+            cuts_bl[idx] = bl
+            cuts_bu[idx] = bu
+            ncuts += 1
+
+        self.lb, self.ub = -np.inf, np.inf
+        best_xhat = xhat.copy()
+        for t in range(1, opts.max_iter + 1):
+            self.iterations = t
+            res = _subproblem_cuts(b, jnp.asarray(xhat, b.qp.c.dtype),
+                                   opts.sub_pdhg)
+            status = np.asarray(res["status"])
+            infeas = real & (status == pdhg.INFEASIBLE)
+            cuts_before = ncuts
+            if infeas.any():
+                # feasibility cuts for every certified-infeasible scenario
+                consts = np.asarray(res["feas_const"], np.float64)
+                gfs = np.asarray(res["feas_g"], np.float64)
+                qvals = np.asarray(res["feas_qval"], np.float64)
+                for s in np.nonzero(infeas)[0]:
+                    if not np.isfinite(qvals[s]) or qvals[s] <= 0.0:
+                        continue  # no usable affine certificate
+                    row = np.zeros(ncols)
+                    row[:N] = gfs[s]
+                    add_row(row, bu=-consts[s])
+                if ncuts == cuts_before:
+                    # no usable certificate from any infeasible scenario:
+                    # the master would re-solve the identical problem —
+                    # bail instead of livelocking to max_iter
+                    global_toc("LShaped: infeasible subproblem(s) with no "
+                               "usable Farkas certificate; stopping", True)
+                    break
+            else:
+                # inner bound: primal objective is valid when every real
+                # scenario is primal-feasible at tolerance
+                rp = np.asarray(res["rp"])
+                obj = np.asarray(res["obj"], np.float64)
+                if np.all(rp[real] <= opts.feas_tol):
+                    ub_t = float(np.sum(self._p * obj))
+                    if ub_t < self.ub:
+                        self.ub = ub_t
+                        best_xhat = xhat.copy()
+                # optimality cut(s)
+                alpha = np.asarray(res["alpha"], np.float64)
+                gmat = np.asarray(res["g"], np.float64)
+                if opts.multicut:
+                    for s in np.nonzero(real)[0]:
+                        row = np.zeros(ncols)
+                        row[:N] = -gmat[s]
+                        row[N + s] = 1.0
+                        add_row(row, bl=alpha[s])
+                else:
+                    gbar = np.sum(self._p[:, None] * gmat, axis=0)
+                    abar = float(np.sum(self._p * alpha))
+                    row = np.zeros(ncols)
+                    row[:N] = -gbar
+                    row[N] = 1.0
+                    add_row(row, bl=abar)
+
+            qp_m, scal = self._master_qp(cuts_A, cuts_bl, cuts_bu, eta_lb)
+            xm, val, lb_m, rd_m, done = _master_solve(qp_m,
+                                                      opts.master_pdhg)
+            x_orig = np.asarray(xm, np.float64) * scal.d_col
+            xhat = np.clip(x_orig[:N], self._x_l, self._x_u)
+            if float(rd_m) <= 10.0 * opts.master_pdhg.tol:
+                self.lb = max(self.lb, float(lb_m))
+
+            gap = self.ub - self.lb
+            rel = gap / max(1e-10, abs(self.ub)) if np.isfinite(gap) \
+                else np.inf
+            self.trace.append(dict(iter=t, lb=self.lb, ub=self.ub,
+                                   rel_gap=rel, ncuts=min(ncuts,
+                                                          opts.max_cuts)))
+            global_toc(f"LShaped iter {t}: lb {self.lb:.6g} "
+                       f"ub {self.ub:.6g} rel_gap {rel:.3e}",
+                       opts.display_progress)
+            if self.spcomm is not None:
+                # publish the FRESH master candidate (not the stale
+                # incumbent): the xhat-lshaped spoke's whole job is to
+                # evaluate candidates the hub has not certified yet
+                self.xhat = xhat.copy()
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    break
+            if rel <= opts.tol:
+                break
+
+        self.xhat = best_xhat
+        return dict(bound=self.lb, ub=self.ub, xhat=best_xhat,
+                    iterations=self.iterations, trace=self.trace)
+
+    # -- solution access (parity with PH driver) ---------------------------
+    def first_stage_solution(self) -> np.ndarray:
+        return self.xhat
+
+    def nonant_values(self) -> np.ndarray:
+        return self.xhat[None, :]

@@ -1,7 +1,8 @@
 from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator  # noqa: F401
-from mpisppy_tpu.cylinders.hub import Hub, PHHub  # noqa: F401
+from mpisppy_tpu.cylinders.hub import Hub, LShapedHub, PHHub  # noqa: F401
 from mpisppy_tpu.cylinders.spoke import (  # noqa: F401
     ConvergerSpokeType, Spoke, OuterBoundSpoke, InnerBoundSpoke,
     LagrangianOuterBound, SubgradientOuterBound, XhatXbarInnerBound,
-    XhatShuffleInnerBound, SlamMaxHeuristic, SlamMinHeuristic,
+    XhatLShapedInnerBound, XhatShuffleInnerBound, SlamMaxHeuristic,
+    SlamMinHeuristic,
 )

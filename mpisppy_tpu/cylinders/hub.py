@@ -127,25 +127,36 @@ class PHHub(Hub):
                 self.InnerBoundUpdate(b, ch)
             sp.trace.append((self._iter, b))
 
+    def _fold_own_bounds(self):
+        """Fold bounds the hub algorithm itself produces (PH: none —
+        the trivial bound enters via is_converged)."""
+
+    def _trace_extra(self) -> dict:
+        return {"conv": float(self.opt.state.conv)}
+
     def sync(self):
         """One hub<->spoke exchange: harvest the spokes' previous async
         results, then launch their next round on a fresh snapshot."""
         self._iter += 1
         self._harvest_all()
+        self._fold_own_bounds()
         payload = self._snapshot()
         self.from_hub.put(payload)  # for API parity / inspection
         for sp in self.spokes:
             sp.update(payload)
         abs_gap, rel_gap = self.compute_gaps()
+        extra = self._trace_extra()
         self.trace.append({
-            "iter": self._iter, "conv": float(self.opt.state.conv),
+            "iter": self._iter, **extra,
             "outer": self.BestOuterBound, "inner": self.BestInnerBound,
             "abs_gap": abs_gap, "rel_gap": rel_gap,
             "ob_char": self.latest_ob_char, "ib_char": self.latest_ib_char,
         })
         if self.options.get("display_progress"):
+            conv_str = (f" conv {extra['conv']:9.3e}"
+                        if "conv" in extra else "")
             global_toc(
-                f"iter {self._iter:4d} conv {float(self.opt.state.conv):9.3e}"
+                f"iter {self._iter:4d}{conv_str}"
                 f" outer {self.BestOuterBound:12.5g}"
                 f" inner {self.BestInnerBound:12.5g} rel_gap {rel_gap:8.3e}"
                 f" ({self.latest_ob_char}/{self.latest_ib_char})", True)
@@ -194,4 +205,55 @@ class PHHub(Hub):
                 num_nodes = self.opt.batch.tree.num_nodes
                 return np.broadcast_to(xhat, (num_nodes, xhat.shape[0]))
             return xhat
+        return self._fallback_nonants()
+
+    def _fallback_nonants(self) -> np.ndarray:
         return np.asarray(self.opt.state.xbar_nodes)
+
+
+class LShapedHub(PHHub):
+    """L-shaped (Benders) as the hub algorithm
+    (ref:mpisppy/cylinders/hub.py:618-710 LShapedHub): sends only
+    NONANTS (the master's current candidate) to spokes — no W exists —
+    and folds the Benders lb/ub into the bound bookkeeping."""
+
+    def setup_hub(self):
+        self.opt.spcomm = self
+        for sp in self.spokes:
+            types = sp.converger_spoke_types
+            if ConvergerSpokeType.W_GETTER in types:
+                raise RuntimeError(
+                    "LShapedHub cannot feed W-getter spokes "
+                    "(ref:hub.py:618-710 sends nonants only)")
+            sp.make_windows()
+
+    def _snapshot(self) -> dict:
+        ls = self.opt  # an algos.lshaped.LShapedMethod
+        batch = ls.batch
+        xhat = np.asarray(ls.xhat)
+        S = batch.num_scenarios
+        return {
+            "nonants": np.broadcast_to(xhat, (S, xhat.shape[0])),
+            "xbar_scen": np.broadcast_to(xhat, (S, xhat.shape[0])),
+            "xbar_nodes": xhat[None, :],
+            "iter": self._iter,
+            "bounds": (self.BestOuterBound, self.BestInnerBound),
+        }
+
+    def _fold_own_bounds(self):
+        # the hub algorithm itself produces both bounds
+        self.OuterBoundUpdate(self.opt.lb, "B")
+        if np.isfinite(self.opt.ub):
+            self.InnerBoundUpdate(self.opt.ub, "B")
+
+    def _trace_extra(self) -> dict:
+        return {}
+
+    def is_converged(self) -> bool:
+        return self.determine_termination()
+
+    def main(self):
+        return self.opt.lshaped_algorithm()
+
+    def _fallback_nonants(self) -> np.ndarray:
+        return np.asarray(self.opt.xhat)[None, :]

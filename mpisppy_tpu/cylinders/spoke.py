@@ -279,6 +279,13 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
         return self.bound
 
 
+class XhatLShapedInnerBound(XhatXbarInnerBound):
+    """Evaluates the L-shaped master's candidate x̂ as an inner bound
+    (ref:mpisppy/cylinders/lshaped_bounder.py:14 XhatLShapedInnerBound —
+    identical mechanics to xhat-xbar: the hub's published nonant point is
+    fixed and the recourse evaluated)."""
+
+
 class _SlamHeuristic(InnerBoundSpoke):
     sense_max = True
 

@@ -49,6 +49,7 @@ def _parse_args(module, args=None):
     cfg.xhatxbar_args()
     cfg.xhatshuffle_args()
     cfg.slama_args()
+    cfg.lshaped_args()
     cfg.converger_args()
     cfg.wxbar_read_write_args()
     cfg.multistage()
@@ -117,9 +118,18 @@ def _do_decomp(cfg, module):
         converger = functools.partial(
             PrimalDualConverger,
             tol=cfg.get("primal_dual_converger_tol", 1e-2))
-    hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
-                         converger=converger)
+    if cfg.get("lshaped_hub"):
+        if converger is not None:
+            global_toc("WARNING: converger options are ignored with "
+                       "--lshaped-hub (Benders has its own termination)",
+                       True)
+        hub = vanilla.lshaped_hub(cfg, batch, scenario_names=names)
+    else:
+        hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
+                             converger=converger)
     spokes = []
+    if cfg.get("xhatlshaped"):
+        spokes.append(vanilla.xhatlshaped_spoke(cfg))
     if cfg.get("fwph"):
         spokes.append(vanilla.fwph_spoke(cfg))
     if cfg.get("lagrangian"):

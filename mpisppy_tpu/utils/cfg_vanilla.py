@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from mpisppy_tpu.algos import ph as ph_mod
-from mpisppy_tpu.cylinders.hub import PHHub
+from mpisppy_tpu.cylinders.hub import LShapedHub, PHHub
 from mpisppy_tpu.cylinders import spoke as spoke_mod
 from mpisppy_tpu.ops import pdhg
 
@@ -60,6 +60,35 @@ def ph_hub(cfg, batch, scenario_names=None, rho_setter=None,
             "extensions": extensions,
             "converger": converger,
         },
+    }
+
+
+def lshaped_hub(cfg, batch, scenario_names=None) -> dict:
+    """L-shaped (Benders) as the hub (ref:cfg_vanilla.py lshaped_hub
+    analog; reference wires it via dedicated drivers)."""
+    from mpisppy_tpu.algos import lshaped as ls_mod
+    hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
+                "display_progress": cfg.get("display_progress", False)}
+    if cfg.get("abs_gap") is not None:
+        hub_opts["abs_gap"] = cfg["abs_gap"]
+    if cfg.get("max_stalled_iters") is not None:
+        hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    tol = cfg.get("pdhg_tol", 1e-7)
+    ls_opts = ls_mod.LShapedOptions(
+        max_iter=cfg.get("lshaped_max_iter", 50),
+        tol=cfg.get("rel_gap", 1e-4),
+        multicut=cfg.get("lshaped_multicut", False),
+        sub_pdhg=pdhg.PDHGOptions(tol=tol, max_iters=100_000,
+                                  detect_infeas=True),
+        master_pdhg=pdhg.PDHGOptions(tol=tol, max_iters=200_000),
+        display_progress=cfg.get("display_progress", False),
+    )
+    return {
+        "hub_class": LShapedHub,
+        "hub_kwargs": {"options": hub_opts},
+        "opt_class": ls_mod.LShapedMethod,
+        "opt_kwargs": {"options": ls_opts, "batch": batch,
+                       "scenario_names": scenario_names},
     }
 
 
@@ -123,6 +152,12 @@ def xhatshuffle_spoke(cfg) -> dict:
                   {"pdhg_opts": _pdhg_opts(cfg),
                    "k": cfg.get("xhatshuffle_iter_step", 4),
                    "add_reversed": cfg.get("add_reversed_shuffle", False)})
+
+
+def xhatlshaped_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:679-700."""
+    return _spoke(spoke_mod.XhatLShapedInnerBound,
+                  {"pdhg_opts": _pdhg_opts(cfg)})
 
 
 def slammax_spoke(cfg) -> dict:

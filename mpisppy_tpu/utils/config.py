@@ -189,6 +189,18 @@ class Config:
         self.add_to_config("slammin", "use slam-min heuristic spoke", bool,
                            False)
 
+    def lshaped_args(self):
+        """L-shaped (Benders) hub options (ref:mpisppy/opt/lshaped.py
+        options dict: max_iter/tol/root_solver)."""
+        self.add_to_config("lshaped_hub", "use L-shaped (Benders) as the "
+                           "hub algorithm instead of PH", bool, False)
+        self.add_to_config("lshaped_max_iter", "Benders iterations", int,
+                           50)
+        self.add_to_config("lshaped_multicut", "per-scenario cuts", bool,
+                           False)
+        self.add_to_config("xhatlshaped", "use an xhat-lshaped inner "
+                           "spoke", bool, False)
+
     def converger_args(self):
         """ref:config.py:897-910."""
         self.add_to_config("use_primal_dual_converger",
