@@ -1,0 +1,295 @@
+###############################################################################
+# Asynchronous Projective Hedging (APH), TPU-native.
+#
+# The reference APH (ref:mpisppy/opt/aph.py, after Eckstein et al.,
+# "Asynchronous Projective Hedging for Stochastic Programming") runs a
+# worker thread plus a listener thread doing background MPI Allreduces,
+# and per iteration dispatches only a FRACTION of the subproblems to the
+# CPU solver (ref:opt/aph.py:717+ APH_solve_loop, dispatch_frac).  The
+# projective-splitting math per iteration (Algorithm 2 of the paper;
+# ref:opt/aph.py:277-443,445-658):
+#
+#   y_s   = W_s + rho (x_s - z)      for scenarios solved last round (Eq.25)
+#   xbar  = node_avg(x),  ybar = node_avg(y)        (FirstReduce)
+#   u_s   = x_s - xbar               (Eq.27),  v = ybar
+#   tau   = E[ ||u||^2 + ||v||^2 / gamma ]
+#   phi   = E[ (z - x)·(W - y) ]                    (SecondReduce)
+#   theta = nu * phi / tau   (0 when tau<=0 or phi<=0; Steps 16-17)
+#   W    += theta * u                               (Step 19)
+#   z    += theta * ybar / gamma   (z = xbar at the first iteration; Step 18)
+#   conv  = ||u||_p/||W||_p + ||v||_p/||z||_p       (ref:opt/aph.py:658-686)
+#
+# TPU design: the whole update is ONE jitted step over the scenario
+# batch; node averages are the same segment reductions PH uses (XLA
+# all-reduces under sharding), so the listener thread and its two named
+# reductions disappear.  Fractional dispatch survives as a *mask*: every
+# iteration the `ceil(dispatch_frac * S)` stalest scenarios are selected
+# (the analog of the dispatch record, ref:opt/aph.py:164-168), the batch
+# solve runs warm-started, and non-dispatched scenarios keep their
+# previous iterates — SIMD lanes make the masked work free, while the
+# algorithm sees exactly the reference's partial-dispatch semantics.
+#
+# y is computed AT solve time (post-solve, masked) with the same (W, z)
+# the subproblem objective used; algebraically identical to the
+# reference's Update_y-at-next-iteration with current values
+# (ref:opt/aph.py:172-208) and to its `use_lag` variant, both of which
+# evaluate y with the (W, z) that parameterized the scenario's last
+# solve.
+#
+# Deviation (documented): the reference accumulates the u/v norms
+# UNWEIGHTED for fixed-probability problems with a "the p is not true"
+# comment (ref:opt/aph.py:394-404); here all four norms are consistently
+# probability-weighted — the correct generalization, identical up to a
+# constant factor for uniform probabilities (which cancels in theta,
+# since tau and phi are then scaled equally).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.algos.ph import PH, ph_eobjective
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import pdhg
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class APHOptions:
+    """Static APH options (ref Config group aph_args,
+    ref:mpisppy/utils/config.py:396-430)."""
+
+    default_rho: float = 1.0
+    max_iterations: int = 100          # ref 'aph_max_iterations'
+    conv_thresh: float = 1e-4
+    gamma: float = 1.0                 # ref 'aph_gamma'
+    nu: float = 1.0                    # ref 'aph_nu' (step scaling)
+    dispatch_frac: float = 1.0         # ref 'aph_dispatch_frac'
+    use_dynamic_gamma: bool = False    # ref _calculate_APHgamma
+    subproblem_windows: int = 8
+    iter0_windows: int = 400
+    pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(tol=1e-6)
+    display_progress: bool = False
+    time_limit: float | None = None
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["solver", "W", "y", "z", "xbar", "xbar_nodes", "ybar_nodes",
+                 "conv", "theta", "rho", "gamma", "last_solved", "it",
+                 "pusq_prev", "pvsq_prev"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class APHState:
+    solver: pdhg.PDHGState  # scaled-space subproblem iterates
+    W: Array                # (S, N) duals, original space
+    y: Array                # (S, N) projective-splitting auxiliary duals
+    z: Array                # (S, N) per-scenario view of the z center
+    xbar: Array             # (S, N) per-scenario view of node averages
+    xbar_nodes: Array       # (num_nodes, N)
+    ybar_nodes: Array       # (num_nodes, N)
+    conv: Array             # () APH convergence metric
+    theta: Array            # () last projective step length
+    rho: Array              # (N,) penalty
+    gamma: Array            # () APH gamma (traced: dynamic-gamma safe)
+    last_solved: Array      # (S,) iteration at which s was last dispatched
+    it: Array               # () int32 APH iteration counter
+    pusq_prev: Array        # () previous ||u||_p^2 (dynamic gamma memory)
+    pvsq_prev: Array        # () previous ||v||_p^2
+
+
+def _merge_solver(mask: Array, new: pdhg.PDHGState,
+                  old: pdhg.PDHGState) -> pdhg.PDHGState:
+    """Keep `new` solver iterates only for dispatched scenarios.
+
+    The per-scenario lanes of the batched PDHG state are independent, so
+    a leading-axis select is exactly "those subproblems were not solved"
+    (ref:opt/aph.py:717+ partial dispatch)."""
+    def sel(a, b):
+        if a.ndim == 0:          # global iteration counter
+            return a
+        m = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+    return jax.tree.map(sel, new, old)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def aph_iter0(batch: ScenarioBatch, rho: Array, opts: APHOptions):
+    """Iter0: plain scenario solves (no W, no prox), z = xbar seed, y = 0,
+    dual-certified trivial bound — shares semantics with PH's Iter0
+    (ref:opt/aph.py:992-1067 runs PHBase.Iter0 then seeds z from xbar at
+    the first work-loop pass)."""
+    from mpisppy_tpu.ops import boxqp as _boxqp
+    st0 = pdhg.init_state(batch.qp, opts.pdhg)
+    solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
+    dual = _boxqp.dual_objective(batch.qp, solver.x, solver.y)
+    _, rd, _ = _boxqp.kkt_residuals(batch.qp, solver.x, solver.y)
+    tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
+    real = batch.p > 0.0
+    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    trivial_bound = batch.expectation(dual)
+
+    x_non = batch.nonants(solver.x)
+    xbar, xbar_nodes = batch.node_average(x_non)
+    S, N = x_non.shape
+    dt = batch.qp.c.dtype
+    zeros = jnp.zeros((S, N), dt)
+    st = APHState(
+        solver=solver, W=zeros, y=zeros, z=xbar, xbar=xbar,
+        xbar_nodes=xbar_nodes, ybar_nodes=jnp.zeros_like(xbar_nodes),
+        conv=jnp.asarray(jnp.inf, dt), theta=jnp.zeros((), dt),
+        rho=rho, gamma=jnp.asarray(opts.gamma, dt),
+        last_solved=jnp.zeros(S, jnp.int32), it=jnp.zeros((), jnp.int32),
+        pusq_prev=jnp.asarray(0.0, dt), pvsq_prev=jnp.asarray(0.0, dt),
+    )
+    return st, trivial_bound, certified
+
+
+def _dispatch_mask(batch: ScenarioBatch, st: APHState, n_dispatch: int):
+    """Select the n_dispatch stalest real scenarios (the dispatch record,
+    ref:opt/aph.py:164-168,756+: least-recently-solved first)."""
+    S = batch.num_scenarios
+    if n_dispatch >= S:
+        return jnp.ones(S, bool)
+    staleness = (st.it - st.last_solved).astype(jnp.float32)
+    # penalize padded scenarios so they never win a slot over real ones
+    staleness = jnp.where(batch.p > 0.0, staleness, -1.0)
+    # deterministic tiebreak by scenario index (rotating offset so equal
+    # staleness round-robins rather than always favoring low indices)
+    idx = jnp.arange(S, dtype=jnp.float32)
+    rot = jnp.mod(idx - st.it.astype(jnp.float32), S) / (2.0 * S)
+    _, top = jax.lax.top_k(staleness + rot, n_dispatch)
+    return jnp.zeros(S, bool).at[top].set(True)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def aph_iterk(batch: ScenarioBatch, st: APHState,
+              opts: APHOptions) -> APHState:
+    """One APH iteration: projective step (averages, tau/phi/theta, W/z)
+    then masked partial dispatch of warm-started subproblem solves
+    (ref:opt/aph.py:877-989 APH_iterk, reordered so the step uses the
+    iterates produced by the previous dispatch — same dataflow)."""
+    it = st.it + 1
+    dt = batch.qp.c.dtype
+    S = batch.num_scenarios
+    N = batch.num_nonants
+
+    # ---- FirstReduce: node averages of x and y (ref:opt/aph.py:445-530).
+    # st.xbar IS node_average(nonants(st.solver.x)) by construction (both
+    # iter0 and the tail of this function store the post-dispatch
+    # average), so x's reduction needs no recompute here.
+    x_non = batch.nonants(st.solver.x)
+    xbar, xbar_nodes = st.xbar, st.xbar_nodes
+    ybar, ybar_nodes = batch.node_average(st.y)
+
+    u = x_non - xbar                       # Eq. 27
+    v = ybar                               # per-scenario view of node ybar
+    pusq = batch.expectation(jnp.sum(u * u, axis=-1))
+    pvsq = batch.expectation(jnp.sum(v * v, axis=-1))
+
+    # ---- dynamic gamma (ref:opt/aph.py:228-275), guarded exactly like
+    # the reference: only after iteration 3, only when both norms and
+    # both decrease ratios are positive.
+    if opts.use_dynamic_gamma:
+        u_term = (st.pusq_prev - pusq) / jnp.maximum(pusq, 1e-30)
+        v_term = (st.pvsq_prev - pvsq) / jnp.maximum(pvsq, 1e-30)
+        ok = (it > 3) & (pusq > 0) & (pvsq > 0) & (u_term > 0) & (v_term > 0)
+        gamma = jnp.where(ok, v_term / jnp.maximum(u_term, 1e-30), st.gamma)
+        pusq_prev = jnp.where(ok | (it <= 3), pusq, st.pusq_prev)
+        pvsq_prev = jnp.where(ok | (it <= 3), pvsq, st.pvsq_prev)
+    else:
+        gamma = st.gamma
+        pusq_prev, pvsq_prev = pusq, pvsq
+
+    # ---- SecondReduce: tau and phi (ref:opt/aph.py:330-443)
+    tau = pusq + pvsq / gamma
+    phi = batch.expectation(jnp.sum((st.z - x_non) * (st.W - st.y), axis=-1))
+
+    # ---- Steps 16-19 (ref:opt/aph.py:579-658)
+    theta = jnp.where((tau > 0) & (phi > 0),
+                      opts.nu * phi / jnp.maximum(tau, 1e-30),
+                      jnp.zeros((), dt))
+    W = st.W + theta * u
+    z = jnp.where(it == 1, xbar, st.z + theta * ybar / gamma)
+
+    pwsq = batch.expectation(jnp.sum(W * W, axis=-1))
+    pzsq = batch.expectation(jnp.sum(z * z, axis=-1))
+    pun, pwn = jnp.sqrt(pusq), jnp.sqrt(pwsq)
+    pvn, pzn = jnp.sqrt(pvsq), jnp.sqrt(pzsq)
+    conv = jnp.where((pwn > 0) & (pzn > 0),
+                     pun / jnp.maximum(pwn, 1e-30)
+                     + pvn / jnp.maximum(pzn, 1e-30),
+                     jnp.asarray(jnp.inf, dt))
+
+    # ---- partial dispatch + solve (ref:opt/aph.py:717+; iteration 1
+    # forces full dispatch "to get a decent w for everyone",
+    # ref:opt/aph.py:955-958)
+    n_dispatch = max(1, int(np.ceil(opts.dispatch_frac * batch.num_real)))
+    mask = _dispatch_mask(batch, dataclasses.replace(st, it=it), n_dispatch)
+    mask = mask | (it == 1)
+
+    # subproblem objective: f_s(x) + W·x + rho/2 (x - z)^2  — prox is
+    # around z, not xbar (ref:opt/aph.py:1040-1062)
+    lin = W - st.rho * z
+    quad = jnp.broadcast_to(st.rho, (S, N))
+    qp_eff = batch.with_nonant_linear_quad(lin, quad)
+    solved = pdhg.solve_fixed(qp_eff, opts.subproblem_windows, opts.pdhg,
+                              st.solver)
+    solver = _merge_solver(mask, solved, st.solver)
+
+    # y at solve time with the same (W, z) the objective used (Eq. 25)
+    x_new = batch.nonants(solver.x)
+    y = jnp.where(mask[:, None], W + st.rho * (x_new - z), st.y)
+    last_solved = jnp.where(mask, it, st.last_solved)
+
+    # store the POST-dispatch average so the returned state is
+    # self-consistent (hub snapshots, convergers, nonant_values all see
+    # the same generation as solver.x); the next iteration reuses it.
+    xbar_new, xbar_nodes_new = batch.node_average(x_new)
+
+    return dataclasses.replace(
+        st, solver=solver, W=W, y=y, z=z, xbar=xbar_new,
+        xbar_nodes=xbar_nodes_new, ybar_nodes=ybar_nodes, conv=conv,
+        theta=theta, gamma=gamma, last_solved=last_solved, it=it,
+        pusq_prev=pusq_prev, pvsq_prev=pvsq_prev)
+
+
+aph_eobjective = ph_eobjective  # same reduction; any state with .solver
+
+
+class APH(PH):
+    """Host-side APH driver (ref:mpisppy/opt/aph.py:992-1161 APH_main).
+
+    Subclasses the PH driver — all extension/converger/spcomm plumbing,
+    Eobjective, and solution access are shared; only the jitted step
+    functions differ.  `APH_main() -> (conv, Eobj, trivial_bound)`.
+    The reference warns its conv and Eobj "CANNOT BE EASILY INTERPRETED"
+    (Eobj includes the prox term there); here Eobj is the clean
+    E[f_s(x_s)] at the final iterates, which IS interpretable.
+    """
+
+    _label = "APH"
+
+    def __init__(self, options: APHOptions, batch: ScenarioBatch, **kw):
+        super().__init__(options, batch, **kw)
+        self.state: APHState | None = None
+
+    def _iter0_impl(self):
+        return aph_iter0(self.batch, self.rho, self.options)
+
+    def _iterk_impl(self):
+        return aph_iterk(self.batch, self.state, self.options)
+
+    def _iter_msg(self, k: int, conv: float) -> str:
+        return (f"APH iter {k}: conv = {conv:.3e} "
+                f"theta = {float(self.state.theta):.3e}")
+
+    def APH_main(self):
+        """Returns (conv, Eobj, trivial_bound) (ref:opt/aph.py:992+)."""
+        return self.ph_main()

@@ -183,7 +183,8 @@ class LShapedMethod:
         self._N = len(n_idx)
         self._p = np.asarray(b.p, np.float64)
 
-    def _master_qp(self, cuts_A, cuts_bl, cuts_bu, eta_lb) -> BoxQP:
+    def _master_qp(self, cuts_A, cuts_bl, cuts_bu,
+                   eta_lb) -> "tuple[BoxQP, object]":
         """Master BoxQP over [x (N); eta (1 or S)] with the cut buffer.
 
         Scaled with Ruiz at every (re)build — cut coefficients mix cost

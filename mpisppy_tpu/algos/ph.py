@@ -222,16 +222,29 @@ class PH:
     def local_scenarios(self):  # parity helper for extensions
         return self.scenario_names
 
+    _label = "PH"
+
+    # -- algorithm step hooks (overridden by APH) -------------------------
+    def _iter0_impl(self):
+        return ph_iter0(self.batch, self.rho, self.options)
+
+    def _iterk_impl(self):
+        return ph_iterk(self.batch, self.state, self.options)
+
+    def _iter_msg(self, k: int, conv: float) -> str:
+        return f"{self._label} iter {k}: conv = {conv:.3e}"
+
     def Eobjective(self) -> float:
         return float(ph_eobjective(self.batch, self.state))
 
     def Iter0(self) -> float:
         self._ext("pre_iter0")
-        self.state, tb, cert = ph_iter0(self.batch, self.rho, self.options)
+        self.state, tb, cert = self._iter0_impl()
         self.trivial_bound = float(tb)
         self.trivial_bound_certified = bool(cert)
         self._ext("post_iter0")
-        global_toc(f"PH Iter0: trivial bound = {self.trivial_bound:.6g}",
+        global_toc(f"{self._label} Iter0: trivial bound = "
+                   f"{self.trivial_bound:.6g}",
                    self.options.display_progress)
         return self.trivial_bound
 
@@ -241,12 +254,12 @@ class PH:
         for k in range(1, self.options.max_iterations + 1):
             self._iter = k
             self._ext("miditer")
-            self.state = ph_iterk(self.batch, self.state, self.options)
+            self.state = self._iterk_impl()
             conv = float(self.state.conv)
             self._ext("enditer")
             if self.spcomm is not None:
                 self.spcomm.sync()
-            global_toc(f"PH iter {k}: conv = {conv:.3e}",
+            global_toc(self._iter_msg(k, conv),
                        self.options.display_progress)
             # The hub object takes precedence over the local convergence
             # metric (ref:mpisppy/phbase.py:996-1015 ordering).
@@ -256,7 +269,8 @@ class PH:
                     and self.converger_object.is_converged()):
                 break
             if conv <= self.options.conv_thresh:
-                global_toc(f"PH converged at iter {k} (conv={conv:.3e})",
+                global_toc(f"{self._label} converged at iter {k} "
+                           f"(conv={conv:.3e})",
                            self.options.display_progress)
                 break
             if (self.options.time_limit is not None

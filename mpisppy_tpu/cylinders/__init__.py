@@ -1,5 +1,7 @@
 from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator  # noqa: F401
-from mpisppy_tpu.cylinders.hub import Hub, LShapedHub, PHHub  # noqa: F401
+from mpisppy_tpu.cylinders.hub import (  # noqa: F401
+    APHHub, Hub, LShapedHub, PHHub,
+)
 from mpisppy_tpu.cylinders.spoke import (  # noqa: F401
     ConvergerSpokeType, Spoke, OuterBoundSpoke, InnerBoundSpoke,
     LagrangianOuterBound, SubgradientOuterBound, XhatXbarInnerBound,

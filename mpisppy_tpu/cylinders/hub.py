@@ -211,6 +211,22 @@ class PHHub(Hub):
         return np.asarray(self.opt.state.xbar_nodes)
 
 
+class APHHub(PHHub):
+    """APH as the hub algorithm (ref:mpisppy/cylinders/hub.py:712-724
+    APHHub): identical exchange surface to PHHub — Ws and nonants out,
+    bounds in — minus the barrier-synchronized write-id protocol the
+    reference skips for APH (ref:hub.py:396,420,427-431), which has no
+    analog here anyway."""
+
+    def _trace_extra(self) -> dict:
+        return {"conv": float(self.opt.state.conv),
+                "theta": float(self.opt.state.theta)}
+
+    def main(self):
+        """ref:cylinders/hub.py:722-724."""
+        return self.opt.APH_main()
+
+
 class LShapedHub(PHHub):
     """L-shaped (Benders) as the hub algorithm
     (ref:mpisppy/cylinders/hub.py:618-710 LShapedHub): sends only

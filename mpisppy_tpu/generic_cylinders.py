@@ -41,6 +41,7 @@ def _parse_args(module, args=None):
     cfg.num_scens_optional()
     cfg.popular_args()
     cfg.ph_args()
+    cfg.aph_args()
     cfg.two_sided_args()
     cfg.fwph_args()
     cfg.lagrangian_args()
@@ -123,7 +124,13 @@ def _do_decomp(cfg, module):
             global_toc("WARNING: converger options are ignored with "
                        "--lshaped-hub (Benders has its own termination)",
                        True)
+        if cfg.get("aph_hub"):
+            global_toc("WARNING: --aph-hub is ignored because "
+                       "--lshaped-hub is also set", True)
         hub = vanilla.lshaped_hub(cfg, batch, scenario_names=names)
+    elif cfg.get("aph_hub"):
+        hub = vanilla.aph_hub(cfg, batch, scenario_names=names,
+                              converger=converger)
     else:
         hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
                              converger=converger)

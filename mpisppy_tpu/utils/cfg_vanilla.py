@@ -23,6 +23,17 @@ def _pdhg_opts(cfg) -> pdhg.PDHGOptions:
     return pdhg.PDHGOptions(tol=cfg.get("pdhg_tol", 1e-6))
 
 
+def _hub_opts(cfg) -> dict:
+    """Shared hub termination options (ref:hub.py:82-166 inputs)."""
+    hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
+                "display_progress": cfg.get("display_progress", False)}
+    if cfg.get("abs_gap") is not None:
+        hub_opts["abs_gap"] = cfg["abs_gap"]
+    if cfg.get("max_stalled_iters") is not None:
+        hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    return hub_opts
+
+
 def ph_options(cfg) -> ph_mod.PHOptions:
     return ph_mod.PHOptions(
         default_rho=cfg.get("default_rho", 1.0),
@@ -42,12 +53,7 @@ def ph_options(cfg) -> ph_mod.PHOptions:
 def ph_hub(cfg, batch, scenario_names=None, rho_setter=None,
            extensions=None, converger=None) -> dict:
     """ref:cfg_vanilla.py:93-141."""
-    hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
-                "display_progress": cfg.get("display_progress", False)}
-    if cfg.get("abs_gap") is not None:
-        hub_opts["abs_gap"] = cfg["abs_gap"]
-    if cfg.get("max_stalled_iters") is not None:
-        hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    hub_opts = _hub_opts(cfg)
     return {
         "hub_class": PHHub,
         "hub_kwargs": {"options": hub_opts},
@@ -63,16 +69,46 @@ def ph_hub(cfg, batch, scenario_names=None, rho_setter=None,
     }
 
 
+def aph_hub(cfg, batch, scenario_names=None, rho_setter=None,
+            extensions=None, converger=None) -> dict:
+    """ref:cfg_vanilla.py:142-210 (aph_hub)."""
+    from mpisppy_tpu.algos import aph as aph_mod
+    from mpisppy_tpu.cylinders.hub import APHHub
+    hub_opts = _hub_opts(cfg)
+    aph_opts = aph_mod.APHOptions(
+        default_rho=cfg.get("default_rho", 1.0),
+        max_iterations=cfg.get("max_iterations", 100),
+        conv_thresh=cfg.get("convthresh", 1e-4),
+        gamma=cfg.get("aph_gamma", 1.0),
+        nu=cfg.get("aph_nu", 1.0),
+        dispatch_frac=cfg.get("aph_dispatch_frac", 1.0),
+        use_dynamic_gamma=cfg.get("aph_use_dynamic_gamma", False),
+        subproblem_windows=cfg.get("subproblem_windows", 8),
+        iter0_windows=cfg.get("iter0_windows", 400),
+        pdhg=_pdhg_opts(cfg),
+        display_progress=cfg.get("display_progress", False),
+        time_limit=cfg.get("time_limit"),
+    )
+    return {
+        "hub_class": APHHub,
+        "hub_kwargs": {"options": hub_opts},
+        "opt_class": aph_mod.APH,
+        "opt_kwargs": {
+            "options": aph_opts,
+            "batch": batch,
+            "scenario_names": scenario_names,
+            "rho_setter": rho_setter,
+            "extensions": extensions,
+            "converger": converger,
+        },
+    }
+
+
 def lshaped_hub(cfg, batch, scenario_names=None) -> dict:
     """L-shaped (Benders) as the hub (ref:cfg_vanilla.py lshaped_hub
     analog; reference wires it via dedicated drivers)."""
     from mpisppy_tpu.algos import lshaped as ls_mod
-    hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
-                "display_progress": cfg.get("display_progress", False)}
-    if cfg.get("abs_gap") is not None:
-        hub_opts["abs_gap"] = cfg["abs_gap"]
-    if cfg.get("max_stalled_iters") is not None:
-        hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    hub_opts = _hub_opts(cfg)
     tol = cfg.get("pdhg_tol", 1e-7)
     ls_opts = ls_mod.LShapedOptions(
         max_iter=cfg.get("lshaped_max_iter", 50),

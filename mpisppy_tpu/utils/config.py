@@ -136,10 +136,22 @@ class Config:
                            0.0)
 
     def aph_args(self):
-        """ref:config.py:396-430 — APH's dispatch fraction maps to the
-        subproblem window budget (partial solves are the default here)."""
-        self.add_to_config("aph_frac_needed", "fraction dispatched", float,
-                           1.0)
+        """ref:config.py:396-430."""
+        self.add_to_config("aph_hub", "use APH as the hub algorithm",
+                           bool, False)
+        self.add_to_config("aph_gamma", "APH gamma parameter", float, 1.0)
+        self.add_to_config("aph_nu", "APH step scaling nu", float, 1.0)
+        self.add_to_config("aph_dispatch_frac",
+                           "fraction of subproblems dispatched per "
+                           "iteration", float, 1.0)
+        self.add_to_config("aph_use_dynamic_gamma",
+                           "adapt gamma from the u/v norm decrease ratio",
+                           bool, False)
+        # legacy alias (the listener-consensus fraction has no analog in
+        # the single-program design; kept so reference scripts parse)
+        self.add_to_config("aph_frac_needed",
+                           "legacy parse-only no-op (listener consensus "
+                           "fraction; use --aph-dispatch-frac)", float, 1.0)
 
     def fwph_args(self):
         """ref:config.py:487-520."""
