@@ -125,15 +125,9 @@ def aph_iter0(batch: ScenarioBatch, rho: Array, opts: APHOptions):
     dual-certified trivial bound — shares semantics with PH's Iter0
     (ref:opt/aph.py:992-1067 runs PHBase.Iter0 then seeds z from xbar at
     the first work-loop pass)."""
-    from mpisppy_tpu.ops import boxqp as _boxqp
-    st0 = pdhg.init_state(batch.qp, opts.pdhg)
-    solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
-    dual = _boxqp.dual_objective(batch.qp, solver.x, solver.y)
-    _, rd, _ = _boxqp.kkt_residuals(batch.qp, solver.x, solver.y)
-    tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
-    real = batch.p > 0.0
-    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
-    trivial_bound = batch.expectation(dual)
+    from mpisppy_tpu.algos.ph import iter0_solve_and_certify
+    solver, trivial_bound, certified = iter0_solve_and_certify(
+        batch, opts.iter0_windows, opts.pdhg)
 
     x_non = batch.nonants(solver.x)
     xbar, xbar_nodes = batch.node_average(x_non)

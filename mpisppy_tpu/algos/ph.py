@@ -112,26 +112,35 @@ def _prox_qp(batch: ScenarioBatch, W: Array, xbar: Array, z: Array,
     return batch.with_nonant_linear_quad(lin, quad)
 
 
-@partial(jax.jit, static_argnames=("opts",))
-def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
-    """Iter0: plain scenario solves, xbar, W seed, trivial bound
-    (ref:mpisppy/phbase.py:829-946).
+def iter0_solve_and_certify(batch: ScenarioBatch, windows: int,
+                            pdhg_opts: pdhg.PDHGOptions):
+    """Plain (no W, no prox) scenario solves + dual-certified trivial
+    bound — shared by PH and APH Iter0.
 
     The trivial bound (wait-and-see expectation, ref:spopt.py:377) is
     taken from the DUAL side with a residual certificate: a truncated
     primal iterate can overshoot the scenario optimum, which would make
     E[obj] an INVALID outer bound; the Fenchel dual value at a
     dual-feasible iterate is always valid.  Returns
-    (state, trivial_bound, certified)."""
+    (solver_state, trivial_bound, certified)."""
     from mpisppy_tpu.ops import boxqp as _boxqp
-    st0 = pdhg.init_state(batch.qp, opts.pdhg)
-    solver = pdhg.solve_fixed(batch.qp, opts.iter0_windows, opts.pdhg, st0)
+    st0 = pdhg.init_state(batch.qp, pdhg_opts)
+    solver = pdhg.solve_fixed(batch.qp, windows, pdhg_opts, st0)
     dual = _boxqp.dual_objective(batch.qp, solver.x, solver.y)
     _, rd, _ = _boxqp.kkt_residuals(batch.qp, solver.x, solver.y)
-    tol = jnp.maximum(opts.pdhg.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
+    tol = jnp.maximum(pdhg_opts.tol, 5.0 * jnp.finfo(solver.x.dtype).eps)
     real = batch.p > 0.0
     certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
-    trivial_bound = batch.expectation(dual)
+    return solver, batch.expectation(dual), certified
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
+    """Iter0: plain scenario solves, xbar, W seed, trivial bound
+    (ref:mpisppy/phbase.py:829-946).  Returns
+    (state, trivial_bound, certified)."""
+    solver, trivial_bound, certified = iter0_solve_and_certify(
+        batch, opts.iter0_windows, opts.pdhg)
     zeros = jnp.zeros((batch.num_scenarios, batch.num_nonants),
                       batch.qp.c.dtype)
     zeros_nodes = jnp.zeros((batch.tree.num_nodes, batch.num_nonants),

@@ -230,13 +230,28 @@ def from_specs(specs: list[ScenarioSpec],
             return first  # shared across the batch (broadcasts)
         return np.stack(arrs)
 
+    def stack_A():
+        """Dense specs stack like any field; scipy-sparse specs become
+        one EllMatrix (shared when deterministic, batched-values when
+        only the data varies — the sparsity pattern must be shared)."""
+        raw = [sp.A for sp in specs]
+        import scipy.sparse as sps
+        if not any(sps.issparse(a) for a in raw):
+            return stack("A")
+        from mpisppy_tpu.ops import sparse as sparse_mod
+        if all(a is raw[0] for a in raw[1:]):
+            return sparse_mod.ell_from_scipy(raw[0], dtype)
+        # ell_from_scipy_batch itself collapses value-equal matrices to
+        # a shared block (the sparse analog of stack()'s fallback)
+        return sparse_mod.ell_from_scipy_batch(raw, dtype)
+
     c = np.stack([np.asarray(sp.c, np.float64) for sp in specs])
     q = np.stack([np.zeros(n) if sp.q is None else np.asarray(sp.q, np.float64)
                   for sp in specs])
-    A = stack("A")
+    A = stack_A()
     qp = BoxQP(
         c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype),
-        A=jnp.asarray(A, dtype),
+        A=A if not isinstance(A, np.ndarray) else jnp.asarray(A, dtype),
         bl=jnp.asarray(stack("bl"), dtype), bu=jnp.asarray(stack("bu"), dtype),
         l=jnp.asarray(stack("l"), dtype), u=jnp.asarray(stack("u"), dtype),
     )
@@ -280,7 +295,10 @@ def pad_to_multiple(batch: ScenarioBatch, multiple: int) -> ScenarioBatch:
     def pad_leading(x, batched_ndim):
         """Pad only fields that actually carry the scenario axis (shared
         fields are identified by ndim, not shape[0], so m==S or n==S
-        cannot misfire)."""
+        cannot misfire).  ELL A pads its values; the pattern is shared."""
+        if hasattr(x, "vals"):  # ops.sparse.EllMatrix
+            return dataclasses.replace(
+                x, vals=pad_leading(x.vals, batched_ndim))
         if x.ndim != batched_ndim:
             return x
         reps = jnp.repeat(x[-1:], pad, axis=0)

@@ -127,8 +127,12 @@ def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
         return w / nrm, nrm[..., 0]
 
     _, lam = jax.lax.fori_loop(0, iters, body, (v, jnp.ones(_bshape(p), p.c.dtype)))
-    row_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-1), axis=-1))
-    col_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-2), axis=-1))
+    if hasattr(p.A, "row_sqnorms"):   # ops.sparse.EllMatrix
+        row_lb = jnp.sqrt(jnp.max(p.A.row_sqnorms(), axis=-1))
+        col_lb = jnp.sqrt(jnp.max(p.A.col_sqnorms(), axis=-1))
+    else:
+        row_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-1), axis=-1))
+        col_lb = jnp.sqrt(jnp.max(jnp.sum(p.A * p.A, axis=-2), axis=-1))
     lb = jnp.maximum(jnp.maximum(row_lb, col_lb), 1e-12)
     # lb broadcasts when A is shared across a batched c
     return jnp.maximum(jnp.sqrt(lam), lb)

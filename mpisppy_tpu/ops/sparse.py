@@ -1,0 +1,205 @@
+###############################################################################
+# ELL sparse constraint matrices for the BoxQP kernel.
+#
+# sslp/netdes/uc-class constraint matrices are sparse (flow balance,
+# set-cover, ramp rows touch a handful of columns); at 10^4-10^5
+# scenarios a dense per-scenario (S, m, n) A tensor cannot fit HBM
+# (VERDICT round-1 weakness #3).  The reference never faces this — each
+# Pyomo model hands a scipy-sparse matrix to Gurobi
+# (ref:mpisppy/spopt.py:99-247) — so the TPU design needs its own answer.
+#
+# Format choice: ELLPACK, not BCOO.  Unstructured COO gathers defeat the
+# TPU's vector units and XLA's static-shape tiling; ELL stores a fixed
+# `k = max nonzeros per row` block (vals (..., m, k), cols (m, k)), so
+#   A @ x   = sum_k vals * x[cols]          (one gather + multiply-add)
+#   A.T @ y = scatter-add of vals * y       (one segment reduction)
+# — both static-shape, fully vectorized, batched over scenarios by a
+# leading axis on `vals` alone (the sparsity PATTERN is shared across
+# the batch; only values vary, which is exactly the structure of
+# scenario families where randomness enters the data, not the model).
+#
+# Padding entries point at column 0 with value 0, so no masks are needed
+# anywhere in the hot path.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["vals", "cols"],
+    meta_fields=["n"],
+)
+@dataclasses.dataclass(frozen=True)
+class EllMatrix:
+    """ELLPACK matrix: logical shape (..., m, n).
+
+    vals: (..., m, k) nonzero values (leading batch axis optional).
+    cols: (m, k) int32 column indices, shared across the batch.
+    n:    number of columns (static).
+    """
+
+    vals: Array
+    cols: Array
+    n: int
+
+    # -- dense-array interface shims (BoxQP treats A generically) ---------
+    @property
+    def ndim(self) -> int:
+        """Rank of the LOGICAL matrix: vals (m,k) -> 2; (S,m,k) -> 3."""
+        return self.vals.ndim
+
+    @property
+    def shape(self) -> tuple:
+        return self.vals.shape[:-1] + (self.n,)
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    @property
+    def m(self) -> int:
+        return self.vals.shape[-2]
+
+    @property
+    def k(self) -> int:
+        return self.vals.shape[-1]
+
+    # -- products ---------------------------------------------------------
+    def matvec(self, x: Array) -> Array:
+        """A @ x: gather + multiply-add (no MXU involvement, so no
+        bf16-precision caveat — f32 FMAs throughout)."""
+        flat = self.cols.reshape(-1)
+        g = jnp.take(x, flat, axis=-1).reshape(
+            x.shape[:-1] + self.cols.shape)
+        return jnp.sum(self.vals * g, axis=-1)
+
+    def rmatvec(self, y: Array) -> Array:
+        """A.T @ y via scatter-add over the shared column index."""
+        contrib = self.vals * y[..., None]           # (..., m, k)
+        flat = self.cols.reshape(-1)
+        cflat = contrib.reshape(contrib.shape[:-2] + (-1,))
+        z = jnp.zeros(cflat.shape[:-1] + (self.n,), cflat.dtype)
+        return z.at[..., flat].add(cflat)
+
+    # -- norms (estimate_norm lower bounds, Ruiz) -------------------------
+    def row_sqnorms(self) -> Array:
+        return jnp.sum(self.vals * self.vals, axis=-1)
+
+    def col_sqnorms(self) -> Array:
+        sq = self.vals * self.vals
+        flat = self.cols.reshape(-1)
+        sflat = sq.reshape(sq.shape[:-2] + (-1,))
+        z = jnp.zeros(sflat.shape[:-1] + (self.n,), sflat.dtype)
+        return z.at[..., flat].add(sflat)
+
+
+def from_scipy(A, dtype=np.float64) -> tuple[np.ndarray, np.ndarray]:
+    """(vals, cols) ELL arrays from a scipy.sparse matrix (host-side)."""
+    import scipy.sparse as sps
+    csr = sps.csr_matrix(A)
+    m, n = csr.shape
+    nnz_per_row = np.diff(csr.indptr)
+    k = max(1, int(nnz_per_row.max()))
+    vals = np.zeros((m, k), dtype)
+    cols = np.zeros((m, k), np.int32)
+    for i in range(m):
+        lo, hi = csr.indptr[i], csr.indptr[i + 1]
+        cnt = hi - lo
+        vals[i, :cnt] = csr.data[lo:hi]
+        cols[i, :cnt] = csr.indices[lo:hi]
+    return vals, cols
+
+
+def ell_from_scipy(A, dtype=jnp.float32) -> EllMatrix:
+    """Device EllMatrix from one scipy.sparse matrix."""
+    vals, cols = from_scipy(A)
+    return EllMatrix(vals=jnp.asarray(vals, dtype), cols=jnp.asarray(cols),
+                     n=int(A.shape[1]))
+
+
+def ell_from_scipy_batch(mats, dtype=jnp.float32) -> EllMatrix:
+    """Batched EllMatrix from scipy matrices sharing one sparsity
+    pattern (vals get a leading scenario axis; cols are shared).
+
+    Collapses to a SHARED (unbatched) EllMatrix when all values are
+    equal too — mirroring the dense stack()'s value-equality fallback so
+    rebuilt-per-scenario deterministic matrices don't duplicate S-fold.
+    Vectorized fill: one (nnz,) -> (m, k) slot map shared by the batch,
+    so construction is O(S * nnz) numpy work, no per-row Python loop."""
+    import scipy.sparse as sps
+    first = sps.csr_matrix(mats[0])
+    first.sort_indices()
+    m, n = first.shape
+    nnz_per_row = np.diff(first.indptr)
+    k = max(1, int(nnz_per_row.max()))
+    # slot map: nonzero j (csr order) -> (row, position within row)
+    slot_row = np.repeat(np.arange(m), nnz_per_row)
+    slot_pos = np.arange(first.nnz) - np.repeat(first.indptr[:-1],
+                                                nnz_per_row)
+    cols = np.zeros((m, k), np.int32)
+    cols[slot_row, slot_pos] = first.indices
+
+    data = np.empty((len(mats), first.nnz))
+    data[0] = first.data
+    for s, M in enumerate(mats[1:], start=1):
+        csr = sps.csr_matrix(M)
+        csr.sort_indices()
+        if not (np.array_equal(csr.indptr, first.indptr)
+                and np.array_equal(csr.indices, first.indices)):
+            raise ValueError(
+                f"scenario {s}: sparsity pattern differs from scenario 0 "
+                "(batched ELL needs a shared pattern; densify or pad the "
+                "pattern union on the host first)")
+        data[s] = csr.data
+
+    if (data[1:] == data[0]).all():
+        vals = np.zeros((m, k))
+        vals[slot_row, slot_pos] = data[0]
+    else:
+        vals = np.zeros((len(mats), m, k))
+        vals[:, slot_row, slot_pos] = data
+    return EllMatrix(vals=jnp.asarray(vals, dtype), cols=jnp.asarray(cols),
+                     n=n)
+
+
+def ruiz_scale_ell(vals: np.ndarray, cols: np.ndarray, n: int,
+                   iters: int = 10) -> tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]:
+    """Host-side Ruiz equilibration in ELL form (the sparse analog of
+    ops.boxqp.ruiz_scale's loop).  Returns (scaled_vals, d_row, d_col);
+    batched vals get per-batch scalings."""
+    vals = np.asarray(vals, np.float64).copy()
+    bshape = vals.shape[:-2]
+    m = vals.shape[-2]
+    dr = np.ones(bshape + (m,))
+    dc = np.ones(bshape + (n,))
+    flat_cols = cols.reshape(-1)
+    for _ in range(iters):
+        rmax = np.max(np.abs(vals), axis=-1)
+        # empty rows/columns keep scale 1 (a 1e-12 floor like the dense
+        # path would compound to overflow across iterations here, since
+        # ELL problems legitimately have columns absent from A)
+        rmax = np.where(rmax <= 1e-12, 1.0, rmax)
+        vals /= np.sqrt(rmax)[..., None]
+        dr /= np.sqrt(rmax)
+        cmax = np.zeros(bshape + (n,))
+        av = np.abs(vals).reshape(bshape + (-1,))
+        if bshape:
+            for b in np.ndindex(bshape):
+                np.maximum.at(cmax[b], flat_cols, av[b])
+        else:
+            np.maximum.at(cmax, flat_cols, av)
+        cmax = np.where(cmax <= 1e-12, 1.0, cmax)
+        sq = np.sqrt(cmax)
+        vals /= sq[..., flat_cols].reshape(vals.shape)
+        dc /= sq
+    return vals, dr, dc

@@ -58,6 +58,10 @@ def shard_batch(batch, mesh: Mesh):
     repl = replicated(mesh)
 
     def put(x, batched_ndim):
+        if hasattr(x, "vals"):  # ops.sparse.EllMatrix: shard the values
+            return dataclasses.replace(
+                x, vals=put(x.vals, batched_ndim),
+                cols=jax.device_put(x.cols, repl))
         return jax.device_put(x, shard if x.ndim == batched_ndim else repl)
 
     qp = batch.qp
