@@ -79,7 +79,9 @@ def build_ef(specs: list[ScenarioSpec],
     r = 0
     for s, sp in enumerate(specs):
         ms = sp.A.shape[0]
-        A[r:r + ms, s * n:(s + 1) * n] = sp.A
+        # scipy-sparse scenario matrices densify into the EF block
+        As = sp.A.toarray() if hasattr(sp.A, "toarray") else sp.A
+        A[r:r + ms, s * n:(s + 1) * n] = As
         bl[r:r + ms] = sp.bl
         bu[r:r + ms] = sp.bu
         r += ms

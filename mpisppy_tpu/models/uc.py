@@ -1,0 +1,191 @@
+###############################################################################
+# uc: stochastic unit commitment, generated natively as sparse BoxQP
+# scenario specs (no Pyomo/egret).  The reference drives egret-built
+# Pyomo UC models through PH/FWPH cylinders
+# (ref:examples/uc/uc_funcs.py, paper runs
+# ref:paperruns/larger_uc/uc_cylinders.py) with demand scenarios; this
+# is a native generator with the same decision structure:
+#
+#   first stage  (nonant): commitment u_{g,t} in {0,1}, all hours
+#   second stage:          dispatch  p_{g,t} >= 0, load shed s_t >= 0
+#   gen limits:  Pmin_g u_{g,t} <= p_{g,t} <= Pmax_g u_{g,t}
+#   balance:     sum_g p_{g,t} + s_t = d_t^scen
+#   ramping:     |p_{g,t} - p_{g,t-1}| <= R_g
+#   objective:   sum fixed_g u + c_g p + VOLL * s
+#
+#   randomness: hourly demand d^scen = profile * seeded per-scenario
+#   multiplicative AR(1) noise — only the balance RHS varies, so the
+#   sparse constraint matrix is SHARED across all scenarios (one ELL
+#   block in HBM regardless of scenario count).
+#
+# Scales to the paper-run regime (10-100 units, 24-48 hours,
+# 100-1000+ scenarios, ref:paperruns/larger_uc/quartz/100scen_fw).
+###############################################################################
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sps
+
+from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.utils.sputils import extract_num
+
+_VOLL = 5000.0
+
+
+def synthetic_instance(n_gens: int = 10, n_hours: int = 24,
+                       seed: int = 0) -> dict:
+    """Seeded fleet + demand profile (deterministic given the seed)."""
+    rng = np.random.RandomState(seed)
+    pmax = rng.uniform(50.0, 300.0, n_gens)
+    inst = {
+        "n_gens": n_gens,
+        "n_hours": n_hours,
+        "pmax": pmax,
+        "pmin": 0.3 * pmax,
+        "ramp": 0.35 * pmax,
+        "cvar": rng.uniform(10.0, 40.0, n_gens),     # $/MWh
+        "cfix": rng.uniform(300.0, 1200.0, n_gens),  # $/h committed
+        # diurnal profile peaking at ~70% of fleet capacity
+        "profile": 0.5 * pmax.sum()
+        * (1.0 + 0.35 * np.sin(2.0 * np.pi
+                               * (np.arange(n_hours) - 6.0) / 24.0)),
+        "seed": seed,
+    }
+    return inst
+
+
+def scenario_demand(inst: dict, scennum: int) -> np.ndarray:
+    """Multiplicative AR(1) demand noise, seeded per scenario."""
+    rng = np.random.RandomState(1_000_003 * (inst["seed"] + 1) + scennum)
+    eps = np.zeros(inst["n_hours"])
+    for t in range(inst["n_hours"]):
+        eps[t] = (0.6 * eps[t - 1] if t else 0.0) + rng.normal(0.0, 0.05)
+    return inst["profile"] * (1.0 + eps)
+
+
+def _shared_structure(inst: dict):
+    """(A, c, l, u, integer, nonant_idx) — scenario-independent; cached
+    on the instance dict so the batch compiler's shared-object fast path
+    sees one sparse A for the whole batch."""
+    if "_spec_cache" in inst:
+        return inst["_spec_cache"]
+    G, T = inst["n_gens"], inst["n_hours"]
+    nU = G * T
+    U0, P0, S0 = 0, nU, 2 * nU      # u (g-major: g*T+t), p, shed
+    n = 2 * nU + T
+
+    rows, cols, vals = [], [], []
+    r = 0
+    # pmax: p - Pmax u <= 0 ; pmin: Pmin u - p <= 0
+    for g in range(G):
+        for t in range(T):
+            rows += [r, r]
+            cols += [P0 + g * T + t, U0 + g * T + t]
+            vals += [1.0, -inst["pmax"][g]]
+            r += 1
+    for g in range(G):
+        for t in range(T):
+            rows += [r, r]
+            cols += [U0 + g * T + t, P0 + g * T + t]
+            vals += [inst["pmin"][g], -1.0]
+            r += 1
+    # balance rows (RHS varies per scenario)
+    bal0 = r
+    for t in range(T):
+        for g in range(G):
+            rows.append(r)
+            cols.append(P0 + g * T + t)
+            vals.append(1.0)
+        rows.append(r)
+        cols.append(S0 + t)
+        vals.append(1.0)
+        r += 1
+    # ramping
+    for g in range(G):
+        for t in range(1, T):
+            rows += [r, r]
+            cols += [P0 + g * T + t, P0 + g * T + t - 1]
+            vals += [1.0, -1.0]
+            r += 1
+            rows += [r, r]
+            cols += [P0 + g * T + t - 1, P0 + g * T + t]
+            vals += [1.0, -1.0]
+            r += 1
+    m = r
+    A = sps.csr_matrix((vals, (rows, cols)), shape=(m, n))
+
+    c = np.zeros(n)
+    for g in range(G):
+        c[U0 + g * T:U0 + (g + 1) * T] = inst["cfix"][g]
+        c[P0 + g * T:P0 + (g + 1) * T] = inst["cvar"][g]
+    c[S0:S0 + T] = _VOLL
+
+    l = np.zeros(n)  # noqa: E741
+    u = np.ones(n)
+    for g in range(G):
+        u[P0 + g * T:P0 + (g + 1) * T] = inst["pmax"][g]
+    u[S0:S0 + T] = np.inf
+
+    integer = np.zeros(n, bool)
+    integer[U0:U0 + nU] = True
+    nonant_idx = np.arange(nU, dtype=np.int32)
+    inst["_spec_cache"] = (A, c, l, u, integer, nonant_idx, bal0, m)
+    return inst["_spec_cache"]
+
+
+def scenario_creator(scenario_name: str, instance: dict | None = None,
+                     num_scens: int | None = None, lp_relax: bool = True,
+                     n_gens: int = 10, n_hours: int = 24, seed: int = 0,
+                     **_ignored) -> ScenarioSpec:
+    """Zero-based Scenario<k> names (ref:examples/uc convention)."""
+    if instance is None:
+        instance = synthetic_instance(n_gens, n_hours, seed)
+    A, c, l, u, integer, nonant_idx, bal0, m = _shared_structure(instance)
+    T = instance["n_hours"]
+    k = extract_num(scenario_name)
+    d = scenario_demand(instance, k)
+
+    bl = np.full(m, -np.inf)
+    bu = np.zeros(m)
+    bl[bal0:bal0 + T] = d
+    bu[bal0:bal0 + T] = d
+    # ramp rows upper bounds
+    G = instance["n_gens"]
+    rr = bal0 + T
+    for g in range(G):
+        bu[rr:rr + 2 * (T - 1)] = instance["ramp"][g]
+        rr += 2 * (T - 1)
+
+    integer_eff = integer if not lp_relax else np.zeros_like(integer)
+    return ScenarioSpec(
+        name=scenario_name, c=c, A=A, bl=bl, bu=bu, l=l, u=u,
+        nonant_idx=nonant_idx,
+        probability=None if num_scens is None else 1.0 / num_scens,
+        integer=integer_eff,
+    )
+
+
+def scenario_names_creator(num_scens: int, start: int | None = None):
+    start = 0 if start is None else start
+    return [f"Scenario{i}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("uc_n_gens", "number of thermal units", int, 10)
+    cfg.add_to_config("uc_n_hours", "scheduling horizon (hours)", int, 24)
+    cfg.add_to_config("uc_seed", "instance seed", int, 0)
+
+
+def kw_creator(cfg):
+    return {
+        "instance": synthetic_instance(cfg.get("uc_n_gens", 10),
+                                       cfg.get("uc_n_hours", 24),
+                                       cfg.get("uc_seed", 0)),
+        "num_scens": int(cfg["num_scens"]),
+        "lp_relax": True,
+    }
+
+
+def scenario_denouement(rank, scenario_name, spec, x=None):
+    pass

@@ -102,20 +102,28 @@ class EllMatrix:
         return z.at[..., flat].add(sflat)
 
 
+def _slot_map(csr) -> tuple[np.ndarray, np.ndarray, int]:
+    """Vectorized nonzero -> (row, within-row position) map for a sorted
+    CSR matrix, shared by all ELL constructors."""
+    m = csr.shape[0]
+    nnz_per_row = np.diff(csr.indptr)
+    k = max(1, int(nnz_per_row.max()) if m else 1)
+    slot_row = np.repeat(np.arange(m), nnz_per_row)
+    slot_pos = np.arange(csr.nnz) - np.repeat(csr.indptr[:-1], nnz_per_row)
+    return slot_row, slot_pos, k
+
+
 def from_scipy(A, dtype=np.float64) -> tuple[np.ndarray, np.ndarray]:
     """(vals, cols) ELL arrays from a scipy.sparse matrix (host-side)."""
     import scipy.sparse as sps
     csr = sps.csr_matrix(A)
+    csr.sort_indices()
     m, n = csr.shape
-    nnz_per_row = np.diff(csr.indptr)
-    k = max(1, int(nnz_per_row.max()))
+    slot_row, slot_pos, k = _slot_map(csr)
     vals = np.zeros((m, k), dtype)
     cols = np.zeros((m, k), np.int32)
-    for i in range(m):
-        lo, hi = csr.indptr[i], csr.indptr[i + 1]
-        cnt = hi - lo
-        vals[i, :cnt] = csr.data[lo:hi]
-        cols[i, :cnt] = csr.indices[lo:hi]
+    vals[slot_row, slot_pos] = csr.data
+    cols[slot_row, slot_pos] = csr.indices
     return vals, cols
 
 
@@ -139,12 +147,7 @@ def ell_from_scipy_batch(mats, dtype=jnp.float32) -> EllMatrix:
     first = sps.csr_matrix(mats[0])
     first.sort_indices()
     m, n = first.shape
-    nnz_per_row = np.diff(first.indptr)
-    k = max(1, int(nnz_per_row.max()))
-    # slot map: nonzero j (csr order) -> (row, position within row)
-    slot_row = np.repeat(np.arange(m), nnz_per_row)
-    slot_pos = np.arange(first.nnz) - np.repeat(first.indptr[:-1],
-                                                nnz_per_row)
+    slot_row, slot_pos, k = _slot_map(first)
     cols = np.zeros((m, k), np.int32)
     cols[slot_row, slot_pos] = first.indices
 
@@ -191,13 +194,14 @@ def ruiz_scale_ell(vals: np.ndarray, cols: np.ndarray, n: int,
         rmax = np.where(rmax <= 1e-12, 1.0, rmax)
         vals /= np.sqrt(rmax)[..., None]
         dr /= np.sqrt(rmax)
-        cmax = np.zeros(bshape + (n,))
-        av = np.abs(vals).reshape(bshape + (-1,))
-        if bshape:
-            for b in np.ndindex(bshape):
-                np.maximum.at(cmax[b], flat_cols, av[b])
-        else:
-            np.maximum.at(cmax, flat_cols, av)
+        # one flattened scatter-max for the whole batch: index
+        # b * n + col — no per-scenario Python loop at 1e5 scenarios
+        B = int(np.prod(bshape)) if bshape else 1
+        av = np.abs(vals).reshape(B, -1)
+        offs = (np.arange(B)[:, None] * n + flat_cols[None, :]).reshape(-1)
+        cflat = np.zeros(B * n)
+        np.maximum.at(cflat, offs, av.reshape(-1))
+        cmax = cflat.reshape(bshape + (n,))
         cmax = np.where(cmax <= 1e-12, 1.0, cmax)
         sq = np.sqrt(cmax)
         vals /= sq[..., flat_cols].reshape(vals.shape)

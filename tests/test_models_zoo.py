@@ -1,0 +1,181 @@
+# Model zoo: netdes / sizes / uc / aircond generators — EF oracle
+# checks vs scipy.linprog plus PH end-to-end convergence.
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import aircond, netdes, sizes, uc
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.ops.sparse import EllMatrix
+
+from test_farmer_ef_ph import scipy_ef_solve
+from test_hydro import scipy_ef_solve_tree
+
+
+def _ph(b, rho=1.0, iters=120, conv=5e-2, windows=8, tol=1e-7):
+    opts = ph_mod.PHOptions(
+        default_rho=rho, max_iterations=iters, conv_thresh=conv,
+        subproblem_windows=windows,
+        pdhg=pdhg.PDHGOptions(tol=tol, restart_period=40))
+    algo = ph_mod.PH(opts, b)
+    return algo, algo.ph_main()
+
+
+# ---------------- netdes ----------------
+
+def _netdes_specs(num=4):
+    inst = netdes.synthetic_instance(n_nodes=8, num_scens=num, seed=3)
+    names = netdes.scenario_names_creator(num)
+    return [netdes.scenario_creator(nm, instance=inst, lp_relax=True)
+            for nm in names]
+
+
+def test_netdes_ef_matches_scipy():
+    specs = _netdes_specs(4)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    assert isinstance(b.qp.A, EllMatrix)   # sparse path engaged
+    st = pdhg.solve(b.qp, pdhg.PDHGOptions(tol=1e-6, max_iters=200_000,
+                                           restart_period=40))
+    assert bool(st.done.all())
+    # per-scenario independent solves lower-bound the EF (no nonant ties)
+    ws = float(b.expectation(b.objective(st.x)))
+    assert ws <= sobj + abs(sobj) * 1e-3
+
+
+def test_netdes_ph_converges():
+    specs = _netdes_specs(4)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=300.0, iters=200, conv=1e-2)
+    assert tb <= sobj + abs(sobj) * 1e-3
+    assert conv <= 1e-2
+    assert eobj >= tb - abs(tb) * 1e-3
+
+
+def test_netdes_dat_parser_roundtrip(tmp_path):
+    # synthesize a tiny .dat in the reference format and parse it back
+    content = """/ header comment
+An instance of the stochastic network flow problem.
+/ more header
++
+3
+0.5
+100
+0,1,1;0,0,1;1,0,0
+0,10,20;0,0,30;40,0,0
+2
+0.5,0.5
+--Scenarios--
+0,1,2;0,0,3;4,0,0
+0,5,6;0,0,7;8,0,0
+-2,2,0
+------------- End of Scenario k = 0 -------
+0,2,3;0,0,4;5,0,0
+0,6,7;0,0,8;9,0,0
+-3,3,0
+"""
+    f = tmp_path / "net.dat"
+    f.write_text(content)
+    data = netdes.parse_dat(str(f))
+    assert data["n"] == 3 and len(data["scens"]) == 2
+    assert data["scens"][0]["b"][0] == -2.0
+    assert data["scens"][1]["u"][2, 0] == 9.0
+    specs = [netdes.scenario_creator(f"Scenario{k}", instance=data,
+                                     lp_relax=True) for k in range(2)]
+    b = batch_mod.from_specs(specs)
+    assert b.num_nonants == 4   # 4 arcs in the toy adjacency
+
+
+# ---------------- sizes ----------------
+
+def test_sizes_demand_multipliers_match_reference_data():
+    # SIZES3 scenario files: D2 = {0.7, 1.0, 1.3} * D1
+    assert sizes.demand_multiplier(1, 3) == pytest.approx(0.7)
+    assert sizes.demand_multiplier(2, 3) == pytest.approx(1.0)
+    assert sizes.demand_multiplier(3, 3) == pytest.approx(1.3)
+
+
+def test_sizes_ef_and_ph():
+    names = sizes.scenario_names_creator(3)
+    specs = [sizes.scenario_creator(nm, scenario_count=3, lp_relax=True)
+             for nm in names]
+    sobj, _ = scipy_ef_solve(specs)
+    assert sobj > 0  # production cost, minimization
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=0.5, iters=200, conv=1e-2)
+    assert tb <= sobj * (1 + 1e-3)
+    assert conv <= 1e-2
+    # PH expected objective near the EF optimum
+    assert eobj == pytest.approx(sobj, rel=2e-2)
+
+
+# ---------------- uc ----------------
+
+def test_uc_shared_sparse_structure():
+    inst = uc.synthetic_instance(4, 12, seed=1)
+    names = uc.scenario_names_creator(3)
+    specs = [uc.scenario_creator(nm, instance=inst, num_scens=3)
+             for nm in names]
+    b = batch_mod.from_specs(specs)
+    # deterministic A: ONE shared ELL block (no scenario axis on vals)
+    assert isinstance(b.qp.A, EllMatrix)
+    assert b.qp.A.vals.ndim == 2
+    assert b.num_nonants == 4 * 12
+
+
+def test_uc_ef_and_ph():
+    inst = uc.synthetic_instance(4, 12, seed=1)
+    names = uc.scenario_names_creator(3)
+    specs = [uc.scenario_creator(nm, instance=inst, num_scens=3)
+             for nm in names]
+    sobj, xref = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=50.0, iters=300, conv=1e-2,
+                                 windows=10)
+    assert tb <= sobj * (1 + 1e-3)
+    assert conv <= 1e-2
+    assert eobj == pytest.approx(sobj, rel=2e-2)
+
+
+def test_uc_demand_seeded_and_distinct():
+    inst = uc.synthetic_instance(4, 12, seed=1)
+    d0 = uc.scenario_demand(inst, 0)
+    d0b = uc.scenario_demand(inst, 0)
+    d1 = uc.scenario_demand(inst, 1)
+    np.testing.assert_array_equal(d0, d0b)
+    assert not np.array_equal(d0, d1)
+    assert (d0 > 0).all()
+
+
+# ---------------- aircond ----------------
+
+def test_aircond_demand_walk_shares_nodes():
+    bfs = (2, 2)
+    # scenarios 0 and 1 share the stage-2 node (same first branch)
+    d0 = aircond.demands_for_scenario(0, bfs)
+    d1 = aircond.demands_for_scenario(1, bfs)
+    d2 = aircond.demands_for_scenario(2, bfs)
+    assert d0[0] == d1[0] == d2[0] == 200.0
+    assert d0[1] == d1[1]          # same stage-2 node
+    assert d0[1] != d2[1]          # different branch
+    assert d0[2] != d1[2]          # different leaves
+    assert ((d0 >= 0.0) & (d0 <= 400.0)).all()
+
+
+def test_aircond_ef_and_multistage_ph():
+    bfs = (2, 2)
+    names = aircond.scenario_names_creator(4)
+    specs = [aircond.scenario_creator(nm, branching_factors=bfs)
+             for nm in names]
+    tree = aircond.make_tree(bfs)
+    sobj, _ = scipy_ef_solve_tree(specs, tree)
+    b = batch_mod.from_specs(specs, tree=tree)
+    assert b.tree.num_nodes == 3   # ROOT + 2 stage-2 nodes
+    algo, (conv, eobj, tb) = _ph(b, rho=1.0, iters=200, conv=1e-2)
+    assert tb <= sobj + 1.0
+    assert conv <= 1e-2
+    assert eobj == pytest.approx(sobj, rel=2e-2)
+    # nonant structure: 2 slots per non-leaf stage
+    assert b.num_nonants == 4
