@@ -1,0 +1,414 @@
+###############################################################################
+# Cross-scenario cuts, TPU-native.
+#
+# The reference pairs a CrossScenarioCutSpoke
+# (ref:mpisppy/cylinders/cross_scen_spoke.py:17-303) with a hub
+# CrossScenarioExtension (ref:mpisppy/extensions/cross_scen_extension.py:22-433):
+# every PH subproblem grows eta_k variables for ALL scenarios plus
+# Benders-cut constraints over (x, eta); the spoke picks the hub
+# scenario-x farthest from xbar, generates L-shaped cuts from every
+# scenario's recourse at that candidate, and the hub periodically solves
+# each subproblem with an "EF objective" (own costs + others' etas) for
+# a certified outer bound (char 'C').  The cuts' raison d'etre is
+# cross-scenario FEASIBILITY pressure (netdes-class problems where one
+# scenario's first-stage build under-serves another scenario).
+#
+# TPU design — two augmented views of the batch, both with STATIC
+# preallocated buffers so arriving cuts are functional `.at[].set`
+# updates and nothing recompiles:
+#
+#   * PH view (`augment_rows`): cut ROWS only, no eta columns.  In a PH
+#     subproblem an optimality cut "eta_k >= a + g·x" is VACUOUS (eta_k
+#     has zero cost there, so it absorbs any x), and carrying S free
+#     zero-cost columns measurably degrades PDHG geometry (observed:
+#     drifting iterates on the optimal face).  Only FEASIBILITY cuts
+#     (pure-x Farkas rows) go into the PH subproblems — they are the
+#     cross-scenario feasibility pressure, the mechanism's entire point.
+#   * EF view (`augment_ef`): eta columns + ALL cut rows, used only by
+#     the periodic bound check.  Subproblem s pins its OWN eta at its
+#     lower bound and deactivates its own optimality-cut rows (they are
+#     vacuous for s: s enforces its own recourse exactly), removing the
+#     free column that stalls the kernel.
+#
+# Cut generation is one batched fixed-nonant PDHG solve
+# (algos.lshaped._subproblem_cuts) — dual-certified optimality cuts and
+# Farkas feasibility cuts, valid even for inexact solves.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg
+from mpisppy_tpu.ops.sparse import EllMatrix
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class CrossScenMeta:
+    """Host bookkeeping: both augmented views + the cut registry."""
+
+    n_orig: int
+    m_orig: int
+    S: int
+    max_rounds: int
+    eta_lb: np.ndarray              # (S,)
+    aug_ph: ScenarioBatch           # rows-only view (feasibility cuts)
+    aug_ef: ScenarioBatch           # eta-columns view (all cuts)
+    is_opt: np.ndarray              # (R,) slot holds an optimality cut
+    rounds_used: int = 0
+
+    @property
+    def R(self) -> int:
+        return self.max_rounds * self.S
+
+
+def _extend_cols(x, fill, width):
+    pad = jnp.full(x.shape[:-1] + (width,), fill, x.dtype)
+    return jnp.concatenate([x, pad], axis=-1)
+
+
+def _add_rows(batch: ScenarioBatch, R: int, n_new: int,
+              cut_k: int) -> ScenarioBatch:
+    """Append R inactive rows (and, for the EF view, n_new eta columns)
+    to a batch; cut rows can hold `cut_k` nonzeros in ELL form."""
+    qp = batch.qp
+    n, m = qp.n, qp.m
+    dt = qp.c.dtype
+    S = batch.num_scenarios
+    N = batch.num_nonants
+
+    c = _extend_cols(qp.c, 0.0, n_new) if n_new else qp.c
+    q = _extend_cols(qp.q, 0.0, n_new) if n_new else qp.q
+    l = _extend_cols(qp.l, 0.0, n_new) if n_new else qp.l  # noqa: E741
+    u = _extend_cols(qp.u, jnp.inf, n_new) if n_new else qp.u
+    bl = _extend_cols(qp.bl, -jnp.inf, R)
+    bu = _extend_cols(qp.bu, jnp.inf, R)
+
+    if isinstance(qp.A, EllMatrix):
+        k_new = max(qp.A.k, cut_k)
+        vals, cols = qp.A.vals, qp.A.cols
+        if k_new > qp.A.k:
+            vals = _extend_cols(vals, 0.0, k_new - qp.A.k)
+            cols = jnp.concatenate(
+                [cols, jnp.zeros((m, k_new - qp.A.k), cols.dtype)],
+                axis=-1)
+        # cut-row column pattern: N nonant slots, then (EF view) the
+        # round-r scenario-k row's eta column
+        pat = [jnp.broadcast_to(batch.nonant_idx, (R, N))]
+        if n_new:
+            pat.append((n + jnp.tile(jnp.arange(S),
+                                     R // S))[:, None])
+        pat.append(jnp.zeros((R, k_new - N - (1 if n_new else 0)),
+                             batch.nonant_idx.dtype))
+        cut_cols = jnp.concatenate(pat, axis=-1).astype(cols.dtype)
+        cols = jnp.concatenate([cols, cut_cols], axis=0)
+        vals = jnp.concatenate(
+            [vals, jnp.zeros(vals.shape[:-2] + (R, k_new), vals.dtype)],
+            axis=-2)
+        A = EllMatrix(vals=vals, cols=cols, n=n + n_new)
+    else:
+        bshape = qp.A.shape[:-2]
+        A = qp.A
+        if n_new:
+            A = jnp.concatenate(
+                [A, jnp.zeros(bshape + (m, n_new), dt)], axis=-1)
+        A = jnp.concatenate(
+            [A, jnp.zeros(bshape + (R, n + n_new), dt)], axis=-2)
+
+    d_col = _extend_cols(batch.d_col, 1.0, n_new) if n_new \
+        else batch.d_col
+    d_row = _extend_cols(batch.d_row, 1.0, R)
+    return dataclasses.replace(
+        batch,
+        qp=dataclasses.replace(qp, c=c, q=q, A=A, bl=bl, bu=bu, l=l, u=u),
+        d_col=d_col, d_row=d_row)
+
+
+def make_meta(batch: ScenarioBatch, eta_lb: np.ndarray,
+              max_rounds: int = 8) -> CrossScenMeta:
+    """Build both augmented views
+    (ref:cross_scen_extension.py:273-300 post_iter0 analog)."""
+    S = batch.num_scenarios
+    N = batch.num_nonants
+    R = max_rounds * S
+    aug_ph = _add_rows(batch, R, 0, cut_k=N)
+    aug_ef = _add_rows(batch, R, S, cut_k=N + 1)
+    l = aug_ef.qp.l
+    l = l.at[..., batch.qp.n:].set(
+        jnp.asarray(eta_lb, aug_ef.qp.c.dtype))
+    aug_ef = dataclasses.replace(
+        aug_ef, qp=dataclasses.replace(aug_ef.qp, l=l))
+    return CrossScenMeta(n_orig=batch.qp.n, m_orig=batch.qp.m, S=S,
+                         max_rounds=max_rounds,
+                         eta_lb=np.asarray(eta_lb, np.float64),
+                         aug_ph=aug_ph, aug_ef=aug_ef,
+                         is_opt=np.zeros(R, bool))
+
+
+def launch_cuts(batch: ScenarioBatch, nonants: Array, xbar: Array,
+                opts: pdhg.PDHGOptions) -> dict:
+    """Spoke-side cut generation on the ORIGINAL batch: pick the
+    scenario x farthest from xbar (ref:cross_scen_spoke.py:190-230),
+    solve every scenario's recourse there (one batched PDHG), return
+    DEVICE arrays without blocking (XLA async dispatch)."""
+    from mpisppy_tpu.algos.lshaped import _subproblem_cuts
+    dist = jnp.linalg.norm(nonants - xbar, axis=-1)
+    dist = jnp.where(batch.p > 0.0, dist, -jnp.inf)
+    winner = jnp.argmax(dist)
+    xhat = nonants[winner]
+    cut = _subproblem_cuts(batch, xhat, opts)
+    return {"xhat": xhat, **cut}
+
+
+def package_cuts(raw: dict, opts: pdhg.PDHGOptions) -> dict:
+    """Host-side packaging of launch_cuts results (blocks on the
+    device values).
+
+    Validity gates: a feasibility cut needs a FINITE usable Farkas
+    affine form (qval > 0 with no infinite-bound pairing — the same
+    guard lshaped applies); an optimality cut needs the dual residual
+    certificate (dual_objective overestimates when rd is large, see its
+    docstring).  Scenarios passing neither get `usable=False` and no
+    row is written."""
+    tol = np.maximum(opts.certificate_tol, 1e-6)
+    feas_const = np.asarray(raw["feas_const"])
+    feas_g = np.asarray(raw["feas_g"])
+    # a separating, finite Farkas form is a valid feasibility cut no
+    # matter what the status says (and required even when status says
+    # INFEASIBLE — 'bad' rays with infinite-bound pairings are unusable)
+    infeas = (np.asarray(raw["feas_qval"]) > tol) \
+        & np.isfinite(feas_const) & np.isfinite(feas_g).all(axis=-1)
+    rd = np.asarray(raw["rd"])
+    rdtol = np.maximum(opts.tol, 5.0 * np.finfo(np.float32).eps)
+    opt_ok = rd <= 10.0 * rdtol
+    return {
+        "xhat": np.asarray(raw["xhat"]),
+        "infeas": infeas,
+        "usable": infeas | opt_ok,
+        "feas_g": feas_g,
+        "feas_const": feas_const,
+        "opt_g": np.asarray(raw["g"]),
+        "opt_alpha": np.asarray(raw["alpha"]),
+    }
+
+
+def _scaled_rows(batch_view: ScenarioBatch, meta: CrossScenMeta,
+                 g: np.ndarray, eta_coef: np.ndarray, rhs: np.ndarray):
+    """(slot coefficient block, scaled rhs): cut slopes mapped into the
+    scaled column space with one inf-norm equilibration scale per cut
+    (shared across subproblems so a broadcast bu still works — cut
+    coefficient spreads stall the first-order kernel otherwise)."""
+    nonant_idx = np.asarray(batch_view.nonant_idx)
+    d_all = np.asarray(batch_view.d_col)[..., nonant_idx]
+    d_max = d_all if d_all.ndim == 1 else d_all.max(axis=0)
+    scale = np.maximum(np.max(np.abs(g) * d_max[None, :], axis=-1),
+                       np.abs(eta_coef))
+    scale = np.maximum(scale, 1e-8)
+    return g / scale[:, None], eta_coef / scale, rhs / scale
+
+
+def _write_rows(aug: ScenarioBatch, meta: CrossScenMeta, row0: int,
+                g: np.ndarray, eta_coef: np.ndarray | None,
+                rhs: np.ndarray, active: np.ndarray) -> ScenarioBatch:
+    """Install S cut rows at row0 (inactive entries keep bu=+inf)."""
+    qp = aug.qp
+    dt = qp.c.dtype
+    S = meta.S
+    N = g.shape[-1]
+    nonant_idx = np.asarray(aug.nonant_idx)
+    has_eta = eta_coef is not None
+
+    if isinstance(qp.A, EllMatrix):
+        vals = qp.A.vals
+        if vals.ndim == 2:
+            d_slots = np.asarray(aug.d_col)[nonant_idx]
+            blocks = [g * d_slots[None, :]]
+            if has_eta:
+                blocks.append(eta_coef[:, None])
+            blocks.append(np.zeros((S, qp.A.k - N - int(has_eta))))
+            vals = vals.at[row0:row0 + S].set(
+                jnp.asarray(np.concatenate(blocks, -1), dt))
+        else:
+            d_slots = np.asarray(aug.d_col)[..., nonant_idx]  # (Sb, N)
+            row_vals = g[None, :, :] * d_slots[:, None, :]
+            blocks = [row_vals]
+            if has_eta:
+                blocks.append(np.broadcast_to(
+                    eta_coef[None, :, None], row_vals.shape[:2] + (1,)))
+            blocks.append(np.zeros(row_vals.shape[:2]
+                                   + (qp.A.k - N - int(has_eta),)))
+            vals = vals.at[:, row0:row0 + S].set(
+                jnp.asarray(np.concatenate(blocks, -1), dt))
+        A = dataclasses.replace(qp.A, vals=vals)
+    else:
+        A = qp.A
+        if A.ndim == 2:
+            d_slots = np.asarray(aug.d_col)[nonant_idx]
+            rows = np.zeros((S, A.shape[-1]))
+            rows[:, nonant_idx] = g * d_slots[None, :]
+            if has_eta:
+                rows[np.arange(S), meta.n_orig + np.arange(S)] = eta_coef
+            A = A.at[row0:row0 + S].set(jnp.asarray(rows, dt))
+        else:
+            Sb = A.shape[0]
+            d_slots = np.broadcast_to(
+                np.asarray(aug.d_col)[..., nonant_idx],
+                (Sb, len(nonant_idx)))
+            rows = np.zeros((Sb, S, A.shape[-1]))
+            rows[:, :, nonant_idx] = g[None] * d_slots[:, None, :]
+            if has_eta:
+                rows[:, np.arange(S), meta.n_orig + np.arange(S)] = \
+                    eta_coef
+            A = A.at[:, row0:row0 + S].set(jnp.asarray(rows, dt))
+
+    rhs_eff = np.where(active, rhs, np.inf)
+    bu = qp.bu.at[..., row0:row0 + S].set(jnp.asarray(rhs_eff, dt))
+    return dataclasses.replace(
+        aug, qp=dataclasses.replace(qp, A=A, bu=bu))
+
+
+def write_cuts(meta: CrossScenMeta, package: dict) -> None:
+    """Install one round of cuts into BOTH views (the static-shape
+    analog of ref:cross_scen_extension.py:157-243 make_cuts):
+      PH view:  feasibility rows only          g·x <= -const
+      EF view:  feasibility rows + opt rows    g·x - eta_k <= -alpha_k
+    """
+    # ring buffer: when full, overwrite the OLDEST round — cuts stay
+    # valid forever, but late-iteration candidates sit near the optimum
+    # and dominate the early wait-and-see-era cuts
+    r = meta.rounds_used % meta.max_rounds
+    S = meta.S
+    row0 = meta.m_orig + r * S
+    infeas = package["infeas"]
+
+    usable = package.get("usable", np.ones(S, bool))
+    g = np.where(infeas[:, None], package["feas_g"], package["opt_g"])
+    g = np.where(usable[:, None], g, 0.0)
+    rhs = np.where(infeas, -package["feas_const"], -package["opt_alpha"])
+    rhs = np.where(usable, rhs, np.inf)
+    eta_coef = np.where(infeas, 0.0, -1.0)
+
+    g_ph, _, rhs_ph = _scaled_rows(meta.aug_ph, meta, g,
+                                   np.zeros_like(eta_coef), rhs)
+    meta.aug_ph = _write_rows(meta.aug_ph, meta, row0, g_ph, None,
+                              rhs_ph, active=infeas & usable)
+    g_ef, eta_ef, rhs_ef = _scaled_rows(meta.aug_ef, meta, g, eta_coef,
+                                        rhs)
+    meta.aug_ef = _write_rows(meta.aug_ef, meta, row0, g_ef, eta_ef,
+                              rhs_ef, active=usable)
+    meta.is_opt[row0 - meta.m_orig:row0 - meta.m_orig + S] = \
+        ~infeas & usable
+    meta.rounds_used += 1
+
+
+@partial(jax.jit, static_argnames=("n_orig", "windows", "opts"))
+def _ef_bound_solve(aug: ScenarioBatch, owner: Array, is_opt: Array,
+                    eta_lb: Array, n_orig: int, windows: int,
+                    opts: pdhg.PDHGOptions, st0: pdhg.PDHGState):
+    """Batched EF-objective solves on the eta view: subproblem s
+    minimizes p_s f_s + sum_{k != s} p_k eta_k under its constraints +
+    cuts, with its OWN eta pinned at the lower bound and its own
+    optimality-cut rows deactivated (vacuous for s).  Certified dual
+    values lower-bound the EF optimum; bound = max over certified
+    scenarios (ref:cross_scen_extension.py:80-128 _check_bound)."""
+    qp = aug.qp
+    S = aug.num_scenarios
+    dt = qp.c.dtype
+    p = aug.p
+    c_orig = qp.c[..., :n_orig] * p[:, None]
+    eta_c = jnp.broadcast_to(p[None, :], (S, S)) \
+        * (1.0 - jnp.eye(S, dtype=dt))
+    c_ef = jnp.concatenate([c_orig, eta_c], axis=-1)
+
+    # pin own eta: u[s, n_orig + s] = eta_lb[s]
+    u = jnp.broadcast_to(qp.u, (S, qp.n))
+    u = u.at[jnp.arange(S), n_orig + jnp.arange(S)].set(
+        eta_lb.astype(dt))
+    # deactivate own optimality-cut rows: bu[s, row] = +inf where
+    # owner[row] == s and the slot holds an optimality cut
+    m_orig = qp.m - owner.shape[0]
+    bu_cut = jnp.broadcast_to(qp.bu[..., m_orig:],
+                              (S, owner.shape[0]))
+    own = (owner[None, :] == jnp.arange(S)[:, None]) & is_opt[None, :]
+    bu_cut = jnp.where(own, jnp.inf, bu_cut)
+    bu = jnp.concatenate(
+        [jnp.broadcast_to(qp.bu[..., :m_orig], (S, m_orig)), bu_cut],
+        axis=-1)
+
+    qp_ef = dataclasses.replace(qp, c=c_ef, u=u, bu=bu)
+    # the EF relaxation is feasible and bounded below by construction
+    opts = dataclasses.replace(opts, detect_infeas=False)
+    st = pdhg.solve_fixed(qp_ef, windows, opts, st0)
+    dual = boxqp.dual_objective(qp_ef, st.x, st.y)
+    _, rd, _ = boxqp.kkt_residuals(qp_ef, st.x, st.y)
+    tol = jnp.maximum(opts.tol, 5.0 * jnp.finfo(dt).eps)
+    ok = (rd <= 10.0 * tol) & (p > 0.0)
+    bound = jnp.max(jnp.where(ok, dual, -jnp.inf))
+    return bound, st
+
+
+def ef_check_bound(meta: CrossScenMeta, opts: pdhg.PDHGOptions,
+                   windows: int = 400,
+                   st0: pdhg.PDHGState | None = None):
+    """Host wrapper returning (bound_or_None, warm-startable state)."""
+    aug = meta.aug_ef
+    if st0 is None:
+        st0 = pdhg.init_state(aug.qp, opts)
+    owner = jnp.tile(jnp.arange(meta.S), meta.max_rounds)
+    bound, st = _ef_bound_solve(
+        aug, owner, jnp.asarray(meta.is_opt),
+        jnp.asarray(meta.eta_lb), meta.n_orig, windows, opts, st0)
+    b = float(bound)
+    return (b if np.isfinite(b) else None), st
+
+
+def eta_lower_bounds(batch: ScenarioBatch, opts: pdhg.PDHGOptions,
+                     windows: int = 400, margin: float = 0.05
+                     ) -> np.ndarray:
+    """Valid per-scenario eta lower bounds
+    (ref:cross_scen_spoke.py:120-125 set_eta_bounds + eta-lb cuts).
+
+    Where the wait-and-see dual solve CERTIFIES (rd small), f_k over any
+    x is >= that dual value minus a safety margin.  Where it does not,
+    the dual value can overestimate (see boxqp.dual_objective), so fall
+    back to the all-rows-dropped box relaxation
+    sum_j min_{x_j in [l,u]} (c_j x_j + q_j/2 x_j^2) — always valid,
+    possibly -inf (then that eta is simply unbounded below: weak but
+    sound)."""
+    qp = batch.qp
+    st = pdhg.solve_fixed(qp, windows, opts, pdhg.init_state(qp, opts))
+    dual = np.asarray(boxqp.dual_objective(qp, st.x, st.y), np.float64)
+    _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    tol = max(opts.tol, 5.0 * float(np.finfo(np.float32).eps))
+    certified = np.asarray(rd) <= 10.0 * tol
+    span = max(1.0, float(np.abs(dual).max()))
+
+    S = batch.num_scenarios
+    c = np.broadcast_to(np.asarray(qp.c, np.float64), (S, qp.n))
+    q = np.broadcast_to(np.asarray(qp.q, np.float64), (S, qp.n))
+    l = np.broadcast_to(np.asarray(qp.l, np.float64), (S, qp.n))
+    u = np.broadcast_to(np.asarray(qp.u, np.float64), (S, qp.n))
+    with np.errstate(invalid="ignore"):
+        at_l = np.where(np.isfinite(l), c * l + 0.5 * q * l * l, np.inf)
+        at_l = np.where(np.isfinite(l), at_l,
+                        np.where((c > 0) | (q > 0), -np.inf, 0.0))
+        at_u = np.where(np.isfinite(u), c * u + 0.5 * q * u * u, np.inf)
+        at_u = np.where(np.isfinite(u), at_u,
+                        np.where((c < 0) | (q > 0), -np.inf, 0.0))
+        # interior stationary point for q > 0
+        xs = np.where(q > 0, -c / np.where(q > 0, q, 1.0), 0.0)
+        interior = (q > 0) & (xs > l) & (xs < u)
+        at_s = np.where(interior, c * xs + 0.5 * q * xs * xs, np.inf)
+    box_min = np.minimum(np.minimum(at_l, at_u), at_s).sum(axis=-1)
+    lb = np.where(certified, dual - margin * span, box_min)
+    # keep lb finite (f32-safe): the EF check pins each subproblem's own
+    # eta at its lb, and a -inf pin degenerates the column.  -1e12 is
+    # below any realistic objective, so validity (lb <= min f_k) holds.
+    return np.maximum(lb, -1e12)

@@ -141,3 +141,18 @@ def subgradient_init(batch: ScenarioBatch,
         best_bound=jnp.asarray(-jnp.inf, dt),
         certified=jnp.asarray(False),
     )
+
+
+@partial(jax.jit, static_argnames=())
+def nonant_reduced_costs(batch: ScenarioBatch, W: Array,
+                         solver: pdhg.PDHGState) -> Array:
+    """(S, N) ORIGINAL-space reduced costs of the nonant columns at a
+    Lagrangian solve's (x, y) — the batched analog of the reference's
+    per-scenario solver rc suffix extraction
+    (ref:mpisppy/cylinders/reduced_costs_spoke.py:108-171).
+
+    rc_orig = (c + q x + A'y)[nonant] / d_non: the scaled-space gradient
+    maps to original units through the column scaling."""
+    qp = _lagrangian_qp(batch, W)
+    rc = qp.c + qp.q * solver.x + qp.rmatvec(solver.y)
+    return rc[..., batch.nonant_idx] / batch.d_non

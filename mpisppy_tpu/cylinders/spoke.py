@@ -306,3 +306,160 @@ class SlamMinHeuristic(_SlamHeuristic):
     """ref:cylinders/slam_heuristic.py:121."""
 
     sense_max = False
+
+
+# ---------------------------------------------------------------------------
+# Cut generation (pairs with extensions.cross_scen_extension on the hub)
+# ---------------------------------------------------------------------------
+class CrossScenarioCutSpoke(Spoke):
+    """Cross-scenario L-shaped cut generator
+    (ref:mpisppy/cylinders/cross_scen_spoke.py:17-303).  Consumes the
+    hub's nonants, picks the scenario-x farthest from xbar, solves every
+    scenario's recourse there in ONE batched PDHG call, and leaves a cut
+    package (dual-certified optimality cuts + Farkas feasibility cuts)
+    for the hub's CrossScenarioExtension to install.  Produces no bound
+    itself — the hub extension's periodic EF-objective check does
+    (ref:extensions/cross_scen_extension.py:80-128)."""
+
+    converger_spoke_types = ()  # neither bound type: a cut provider
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        from mpisppy_tpu.ops import pdhg as _pdhg
+        # cuts are generated on the ORIGINAL (un-augmented) batch
+        self.orig_batch = getattr(opt, "_cross_scen_orig_batch", opt.batch)
+        base = self.options.get("pdhg_opts", _pdhg.PDHGOptions())
+        self.cut_opts = dataclasses_replace_pdhg(base)
+        self.cut_package: dict | None = None
+        self.new_cuts = False
+
+    def update(self, hub_payload):
+        from mpisppy_tpu.algos import cross_scen
+        self._pending = cross_scen.launch_cuts(
+            self.orig_batch, hub_payload["nonants"],
+            hub_payload["xbar_scen"], self.cut_opts)
+
+    def harvest(self):
+        from mpisppy_tpu.algos import cross_scen
+        if self._pending is None:
+            return None
+        self.cut_package = cross_scen.package_cuts(self._pending,
+                                                   self.cut_opts)
+        self.new_cuts = True
+        self._pending = None
+        return None  # no bound
+
+
+def dataclasses_replace_pdhg(base):
+    """Cut solves need infeasibility detection on; everything else
+    follows the configured kernel options."""
+    import dataclasses as _dc
+    return _dc.replace(base, detect_infeas=True, max_iters=100_000)
+
+
+class ReducedCostsSpoke(LagrangianOuterBound):
+    """Lagrangian bound spoke that also extracts nonant reduced costs
+    for the hub's ReducedCostsFixer
+    (ref:mpisppy/cylinders/reduced_costs_spoke.py:16-175).
+
+    Publishes, besides the bound: `rc_global` (N,) expected reduced
+    costs — NaN where the scenarios disagree (xbar variance above
+    sqrt(bound_tol), ref:reduced_costs_spoke.py:139-143) or where xbar
+    sits away from both bounds — and `rc_scenario` (S, N) raw
+    per-scenario values."""
+
+    converger_spoke_char = "R"
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        if self.batch.tree.num_nodes != 1:
+            # xbar/consensus below are root-node reductions; per-node
+            # variants would be needed first (mirrors the reference's
+            # two-stage-only usage of rc fixing)
+            raise RuntimeError("ReducedCostsSpoke supports two-stage "
+                               "problems only")
+        self.bound_tol = float(self.options.get("rc_bound_tol", 1e-6))
+        self.consensus_threshold = float(np.sqrt(self.bound_tol))
+        self.rc_global: np.ndarray | None = None
+        self.rc_scenario: np.ndarray | None = None
+        self.new_rc = False
+        # original-space nonant box (static: hoisted from the harvest
+        # path so no per-iteration (S, n) device pulls)
+        nonant_idx = np.asarray(self.batch.nonant_idx)
+        S = self.batch.num_scenarios
+        qp = self.batch.qp
+        d = np.broadcast_to(np.asarray(self.batch.d_non),
+                            (S, len(nonant_idx)))
+        l = np.broadcast_to(np.asarray(qp.l), (S, qp.n))[:, nonant_idx] * d
+        u = np.broadcast_to(np.asarray(qp.u), (S, qp.n))[:, nonant_idx] * d
+        self._nonant_lb, self._nonant_ub = l.max(0), u.min(0)
+
+    def update(self, hub_payload):
+        super().update(hub_payload)
+        res = self._pending
+        self._rc_dev = lag_mod.nonant_reduced_costs(
+            self.batch, hub_payload["W"], res.solver)
+        self._x_dev = self.batch.nonants(res.solver.x)
+
+    def harvest(self):
+        b = super().harvest()
+        if self._pending is None:
+            return b
+        if not bool(self._pending.certified):
+            # an unconverged Lagrangian solve has arbitrary-sign reduced
+            # costs; publishing them would let the fixer pin variables
+            # to the wrong bound
+            return b
+        # record the certified Lagrangian bound of the SAME solve the
+        # rcs come from — the fixer's bound-tightening gap needs it
+        self.last_lagrangian_bound = float(self._pending.bound)
+        rc = np.asarray(self._rc_dev, np.float64)       # (S, N)
+        x = np.asarray(self._x_dev, np.float64)
+        p = np.asarray(self.batch.p, np.float64)
+        xbar = (p[:, None] * x).sum(0)
+        var = (p[:, None] * x * x).sum(0) - xbar * xbar
+        self.rc_scenario = rc
+        exp_rc = (p[:, None] * rc).sum(0)
+        at_bound = (xbar - self._nonant_lb <= self.bound_tol) \
+            | (self._nonant_ub - xbar <= self.bound_tol)
+        consensus = var <= self.consensus_threshold ** 2
+        exp_rc = np.where(consensus & at_bound, exp_rc, np.nan)
+        self.rc_global = exp_rc
+        self.new_rc = True
+        return b
+
+
+class PhOuterBound(OuterBoundSpoke):
+    """PH itself as an outer-bound engine (ref:mpisppy/cylinders/
+    ph_ob.py:21-175): runs its OWN PH iterations with rescaled
+    (typically much smaller) rho, and after each iteration evaluates the
+    Lagrangian bound at its own W — valid because PH's W update keeps
+    the p-weighted node mean of W at zero (ref:phbase.py:114-179)."""
+
+    converger_spoke_char = "P"
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        from mpisppy_tpu.algos import ph as ph_mod
+        self._ph_mod = ph_mod
+        rescale = float(self.options.get("ph_ob_rho_rescale", 0.1))
+        base_rho = float(self.options.get("rho", 1.0))
+        self._ph_opts = ph_mod.PHOptions(
+            default_rho=base_rho * rescale,
+            subproblem_windows=int(self.options.get("n_windows", 8)),
+            pdhg=self.pdhg_opts)
+        self._rho = jnp.broadcast_to(
+            jnp.asarray(base_rho * rescale, self.batch.qp.c.dtype),
+            (self.batch.num_nonants,))
+        self._st = None
+
+    def update(self, hub_payload):
+        if self._st is None:
+            self._st, _, _ = self._ph_mod.ph_iter0(
+                self.batch, self._rho, self._ph_opts)
+        else:
+            self._st = self._ph_mod.ph_iterk(self.batch, self._st,
+                                             self._ph_opts)
+        self._pending = lag_mod.lagrangian_bound(
+            self.batch, self._st.W, self.pdhg_opts,
+            self._pending.solver if self._pending is not None else None)

@@ -1,0 +1,119 @@
+###############################################################################
+# Hub side of cross-scenario cuts
+# (ref:mpisppy/extensions/cross_scen_extension.py:22-433).
+#
+# At construction it swaps the PH driver's batch for the eta-augmented
+# one (static preallocated cut buffer, algos.cross_scen.augment_batch);
+# each iteration it installs any new cut package from the
+# CrossScenarioCutSpoke (functional .at[] writes — no recompilation) and
+# periodically solves the batched EF objective for a certified outer
+# bound (char 'C', ref:cross_scen_extension.py:80-128 _check_bound),
+# gated the same way: only when the inner bound has not improved for
+# `check_bound_improve_iterations` hub iterations.
+###############################################################################
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.algos import cross_scen
+from mpisppy_tpu.extensions.extension import Extension
+from mpisppy_tpu.ops import pdhg
+
+
+class CrossScenarioExtension(Extension):
+    def __init__(self, ph, check_bound_improve_iterations: int | None = 4,
+                 max_rounds: int = 8,
+                 pdhg_opts: pdhg.PDHGOptions | None = None):
+        super().__init__(ph)
+        if ph.batch.tree.num_nodes != 1:
+            raise RuntimeError("CrossScenarioExtension only supports "
+                               "two-stage models at this time "
+                               "(ref:cross_scen_extension.py:26-28)")
+        self.check_bound_iterations = check_bound_improve_iterations
+        self.pdhg_opts = pdhg_opts or pdhg.PDHGOptions(tol=1e-7,
+                                                       max_iters=100_000)
+        # augment the driver's batch in place: preallocated cut rows
+        # (the eta-column EF view lives only in the meta)
+        ph._cross_scen_orig_batch = ph.batch
+        eta_lb = cross_scen.eta_lower_bounds(ph.batch, self.pdhg_opts)
+        self.meta = cross_scen.make_meta(ph.batch, eta_lb,
+                                         max_rounds=max_rounds)
+        ph.batch = self.meta.aug_ph
+        self.any_cuts = False
+        self.cur_ib = math.inf
+        self.iter_at_cur_ib = 0
+        self.iter_since_last_check = 0
+        self._ef_warm = None
+
+    # -- cut installation -------------------------------------------------
+    def _spoke(self):
+        from mpisppy_tpu.cylinders.spoke import CrossScenarioCutSpoke
+        spcomm = self.opt.spcomm
+        if spcomm is None:
+            return None
+        for sp in getattr(spcomm, "spokes", []):
+            if isinstance(sp, CrossScenarioCutSpoke):
+                return sp
+        return None
+
+    def _get_cuts(self):
+        sp = self._spoke()
+        if sp is None or not sp.new_cuts:
+            return
+        sp.new_cuts = False
+        # other extensions (e.g. ReducedCostsFixer) may have tightened
+        # or collapsed boxes on the live batch; sync them into the PH
+        # view BEFORE installing cuts so they are never reverted
+        import dataclasses as _dc
+        live = self.opt.batch.qp
+        self.meta.aug_ph = _dc.replace(
+            self.meta.aug_ph,
+            qp=_dc.replace(self.meta.aug_ph.qp, l=live.l, u=live.u))
+        cross_scen.write_cuts(self.meta, sp.cut_package)
+        self.opt.batch = self.meta.aug_ph
+        self.any_cuts = True
+        self._ef_warm = None   # shapes same, but cuts moved the problem
+
+    # -- periodic EF-objective bound check --------------------------------
+    def _check_bound(self):
+        bound, st = cross_scen.ef_check_bound(
+            self.meta, self.pdhg_opts, st0=self._ef_warm)
+        self._ef_warm = st
+        if bound is not None and self.opt.spcomm is not None:
+            self.opt.spcomm.OuterBoundUpdate(bound, "C")
+            global_toc(f"cross-scen EF bound: {bound:.6g}",
+                       self.opt.options.display_progress)
+
+    def miditer(self):
+        self._get_cuts()
+        if self.check_bound_iterations is None or not self.any_cuts:
+            return
+        spcomm = self.opt.spcomm
+        ib = spcomm.BestInnerBound if spcomm is not None else math.inf
+        if ib != self.cur_ib:
+            self.cur_ib = ib
+            self.iter_at_cur_ib = self.opt._iter
+        self.iter_since_last_check += 1
+        stalled = (self.opt._iter - self.iter_at_cur_ib
+                   >= self.check_bound_iterations)
+        if stalled and \
+                self.iter_since_last_check >= self.check_bound_iterations:
+            self.iter_since_last_check = 0
+            self._check_bound()
+
+    def enditer(self):
+        pass
+
+    def post_everything(self):
+        # one final bound attempt so late cuts count
+        self._get_cuts()
+        if self.any_cuts:
+            self._check_bound()
+
+    # parity attribute used by hub traces
+    @property
+    def cuts_installed(self) -> int:
+        return self.meta.rounds_used * self.meta.S

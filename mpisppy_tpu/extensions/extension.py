@@ -72,7 +72,11 @@ class MultiExtension(Extension):
         super().__init__(ph)
         self.extdict = {}
         for cls in ext_classes:
-            self.extdict[cls.__name__] = cls(ph)
+            # classes, factories, and functools.partial(s) all work
+            name = getattr(cls, "__name__", None) \
+                or getattr(getattr(cls, "func", None), "__name__", None) \
+                or f"ext{len(self.extdict)}"
+            self.extdict[name] = cls(ph)
 
     def _fan(self, hook, *args):
         for ext in self.extdict.values():

@@ -50,6 +50,9 @@ def _parse_args(module, args=None):
     cfg.xhatxbar_args()
     cfg.xhatshuffle_args()
     cfg.slama_args()
+    cfg.reduced_costs_args()
+    cfg.ph_ob_args()
+    cfg.cross_scenario_cuts_args()
     cfg.lshaped_args()
     cfg.converger_args()
     cfg.wxbar_read_write_args()
@@ -132,9 +135,29 @@ def _do_decomp(cfg, module):
         hub = vanilla.aph_hub(cfg, batch, scenario_names=names,
                               converger=converger)
     else:
+        extensions = None
+        ext_factories = []
+        if cfg.get("cross_scenario_cuts"):
+            ext_factories.append(vanilla.cross_scenario_extension(cfg))
+        if cfg.get("reduced_costs"):
+            ext_factories.append(vanilla.reduced_costs_fixer(cfg))
+        if len(ext_factories) == 1:
+            extensions = ext_factories[0]
+        elif ext_factories:
+            from mpisppy_tpu.extensions.extension import MultiExtension
+            import functools
+            extensions = functools.partial(MultiExtension,
+                                           ext_classes=ext_factories)
         hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
-                             converger=converger)
+                             converger=converger, extensions=extensions)
     spokes = []
+    if not cfg.get("lshaped_hub") and not cfg.get("aph_hub"):
+        if cfg.get("cross_scenario_cuts"):
+            spokes.append(vanilla.cross_scenario_cuts_spoke(cfg))
+        if cfg.get("reduced_costs"):
+            spokes.append(vanilla.reduced_costs_spoke(cfg))
+    if cfg.get("ph_ob"):
+        spokes.append(vanilla.ph_ob_spoke(cfg))
     if cfg.get("xhatlshaped"):
         spokes.append(vanilla.xhatlshaped_spoke(cfg))
     if cfg.get("fwph"):

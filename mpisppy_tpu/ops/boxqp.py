@@ -237,7 +237,14 @@ def unboundedness_certificate(p: BoxQP, d: Array, tol: float = 1e-6) -> Array:
         jnp.where(jnp.isfinite(p.u), dn <= tol, True)
         & jnp.where(jnp.isfinite(p.l), dn >= -tol, True), axis=-1)
     no_curv = jnp.sum(p.q * dn * dn, axis=-1) <= tol
-    descent = jnp.sum(p.c * dn, axis=-1) < -tol
+    # Descent threshold is COST-SCALE relative: with large |c|, stray
+    # ray components of size ~tol (which ok_box/ok_rows tolerate) times
+    # big coefficients would fake a descent direction on a bounded
+    # problem (observed: a zero-cost free column plus tol-sized noise
+    # certified "unbounded").  A true recession ray's descent rate is
+    # proportional to the cost scale, so nothing real is lost.
+    cscale = 1.0 + jnp.max(jnp.abs(p.c), axis=-1)
+    descent = jnp.sum(p.c * dn, axis=-1) < -tol * cscale
     return ok_rows & ok_box & no_curv & descent & (nrm[..., 0] > 1e-30)
 
 

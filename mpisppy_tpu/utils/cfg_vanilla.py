@@ -176,6 +176,62 @@ def fwph_spoke(cfg) -> dict:
                    "rho": cfg.get("default_rho", 1.0)})
 
 
+def reduced_costs_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:466-492."""
+    return _spoke(spoke_mod.ReducedCostsSpoke,
+                  {"pdhg_opts": _pdhg_opts(cfg),
+                   "rc_bound_tol": cfg.get("rc_bound_tol", 1e-6)})
+
+
+def reduced_costs_fixer(cfg):
+    """Factory for the hub-side fixer extension."""
+    import functools
+    from mpisppy_tpu.extensions.reduced_costs_fixer import (
+        ReducedCostsFixer,
+    )
+    return functools.partial(
+        ReducedCostsFixer,
+        fix_fraction_target_iter0=cfg.get("rc_fix_fraction_iter0", 0.0),
+        fix_fraction_target_iterK=cfg.get("rc_fix_fraction_iterk", 0.0),
+        zero_rc_tol=cfg.get("rc_zero_rc_tol", 1e-4),
+        bound_tol=cfg.get("rc_bound_tol", 1e-6),
+        use_rc_bt=cfg.get("rc_bound_tightening", False),
+    )
+
+
+def ph_ob_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:781-820."""
+    return _spoke(spoke_mod.PhOuterBound,
+                  {"pdhg_opts": _pdhg_opts(cfg),
+                   "rho": cfg.get("default_rho", 1.0),
+                   "ph_ob_rho_rescale":
+                       cfg.get("ph_ob_rho_rescale_factor", 0.1)})
+
+
+def cross_scenario_cuts_spoke(cfg) -> dict:
+    """ref:cfg_vanilla.py:743-780."""
+    from mpisppy_tpu.cylinders.spoke import CrossScenarioCutSpoke
+    return _spoke(CrossScenarioCutSpoke,
+                  {"pdhg_opts": _pdhg_opts(cfg)})
+
+
+def cross_scenario_extension(cfg):
+    """Factory for the hub-side extension (pass as ph_hub
+    extensions=...)."""
+    import functools
+    from mpisppy_tpu.extensions.cross_scen_extension import (
+        CrossScenarioExtension,
+    )
+    return functools.partial(
+        CrossScenarioExtension,
+        check_bound_improve_iterations=cfg.get("cross_scenario_iter_cnt",
+                                               4),
+        max_rounds=cfg.get("cross_scenario_max_rounds", 8),
+        pdhg_opts=pdhg.PDHGOptions(tol=cfg.get("pdhg_tol", 1e-6),
+                                   max_iters=100_000),
+    )
+
+
 def xhatxbar_spoke(cfg) -> dict:
     """ref:cfg_vanilla.py:589-621."""
     return _spoke(spoke_mod.XhatXbarInnerBound,

@@ -195,6 +195,44 @@ class Config:
         self.add_to_config("xhatshuffle_iter_step",
                            "candidates per sync", int, 4)
 
+    def reduced_costs_args(self):
+        """ref:config.py:539-600."""
+        self.add_to_config("reduced_costs",
+                           "use a reduced-costs spoke + fixer", bool,
+                           False)
+        self.add_to_config("rc_bound_tol", "at-bound tolerance for rc "
+                           "extraction", float, 1e-6)
+        self.add_to_config("rc_zero_rc_tol", "zero reduced-cost "
+                           "tolerance", float, 1e-4)
+        self.add_to_config("rc_fix_fraction_iter0",
+                           "fraction of nonants to fix after iter0",
+                           float, 0.0)
+        self.add_to_config("rc_fix_fraction_iterk",
+                           "fraction of nonants to fix at iter k",
+                           float, 0.0)
+        self.add_to_config("rc_bound_tightening",
+                           "tighten nonant bounds from reduced costs",
+                           bool, False)
+
+    def ph_ob_args(self):
+        """ref:config.py ph_ob group."""
+        self.add_to_config("ph_ob", "use a PH outer-bound spoke", bool,
+                           False)
+        self.add_to_config("ph_ob_rho_rescale_factor",
+                           "rho rescale for the ph_ob spoke", float, 0.1)
+
+    def cross_scenario_cuts_args(self):
+        """ref:config.py cross_scenario_cuts group."""
+        self.add_to_config("cross_scenario_cuts",
+                           "use a cross-scenario cut spoke + hub "
+                           "extension", bool, False)
+        self.add_to_config("cross_scenario_iter_cnt",
+                           "hub iterations between EF bound checks",
+                           int, 4)
+        self.add_to_config("cross_scenario_max_rounds",
+                           "capacity of the preallocated cut buffer "
+                           "(rounds of S cuts)", int, 8)
+
     def slama_args(self):
         self.add_to_config("slammax", "use slam-max heuristic spoke", bool,
                            False)
