@@ -295,8 +295,13 @@ def write_cuts(meta: CrossScenMeta, package: dict) -> None:
     rhs = np.where(usable, rhs, np.inf)
     eta_coef = np.where(infeas, 0.0, -1.0)
 
-    g_ph, _, rhs_ph = _scaled_rows(meta.aug_ph, meta, g,
-                                   np.zeros_like(eta_coef), rhs)
+    # the PH view holds ONLY feasibility rows; optimality-cut slopes
+    # must not even occupy inactive rows there (nonzero coefficients
+    # would inflate the PH subproblems' operator-norm estimate)
+    g_feas = np.where((infeas & usable)[:, None], g, 0.0)
+    rhs_feas = np.where(infeas & usable, rhs, np.inf)
+    g_ph, _, rhs_ph = _scaled_rows(meta.aug_ph, meta, g_feas,
+                                   np.zeros_like(eta_coef), rhs_feas)
     meta.aug_ph = _write_rows(meta.aug_ph, meta, row0, g_ph, None,
                               rhs_ph, active=infeas & usable)
     g_ef, eta_ef, rhs_ef = _scaled_rows(meta.aug_ef, meta, g, eta_coef,

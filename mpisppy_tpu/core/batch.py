@@ -146,6 +146,18 @@ class ScenarioBatch:
         avg_scen = jnp.take_along_axis(avg_nodes, self.node_of_slot, axis=0)
         return avg_scen, avg_nodes
 
+    def nonant_box(self) -> "tuple[np.ndarray, np.ndarray]":
+        """(lb, ub) of the nonant slots in ORIGINAL space: the tightest
+        intersection across scenarios (host arrays; static per batch)."""
+        nonant_idx = np.asarray(self.nonant_idx)
+        S = self.num_scenarios
+        d = np.broadcast_to(np.asarray(self.d_non), (S, len(nonant_idx)))
+        l_s = np.broadcast_to(np.asarray(self.qp.l),
+                              (S, self.qp.n))[:, nonant_idx] * d
+        u_s = np.broadcast_to(np.asarray(self.qp.u),
+                              (S, self.qp.n))[:, nonant_idx] * d
+        return l_s.max(0), u_s.min(0)
+
     def expectation(self, vals: Array) -> Array:
         """E[vals] over scenarios — Eobjective/Ebound style reduction
         (ref:mpisppy/spopt.py:344-436)."""

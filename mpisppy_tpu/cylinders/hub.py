@@ -120,7 +120,10 @@ class PHHub(Hub):
             b = sp.harvest()
             if b is None:
                 continue
-            ch = type(sp).__name__[0]
+            # spokes may declare their trace char (ref spoke classes'
+            # converger_spoke_char); default to the class initial
+            ch = getattr(sp, "converger_spoke_char",
+                         type(sp).__name__[0])
             if ConvergerSpokeType.OUTER_BOUND in sp.converger_spoke_types:
                 self.OuterBoundUpdate(b, ch)
             elif ConvergerSpokeType.INNER_BOUND in sp.converger_spoke_types:

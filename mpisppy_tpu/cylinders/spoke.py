@@ -325,11 +325,14 @@ class CrossScenarioCutSpoke(Spoke):
 
     def __init__(self, opt, options=None):
         super().__init__(opt, options)
-        from mpisppy_tpu.ops import pdhg as _pdhg
+        import dataclasses as _dc
         # cuts are generated on the ORIGINAL (un-augmented) batch
         self.orig_batch = getattr(opt, "_cross_scen_orig_batch", opt.batch)
-        base = self.options.get("pdhg_opts", _pdhg.PDHGOptions())
-        self.cut_opts = dataclasses_replace_pdhg(base)
+        # cut solves need infeasibility detection and a full-convergence
+        # budget (never LOWER than configured)
+        self.cut_opts = _dc.replace(
+            self.pdhg_opts, detect_infeas=True,
+            max_iters=max(self.pdhg_opts.max_iters, 100_000))
         self.cut_package: dict | None = None
         self.new_cuts = False
 
@@ -350,11 +353,6 @@ class CrossScenarioCutSpoke(Spoke):
         return None  # no bound
 
 
-def dataclasses_replace_pdhg(base):
-    """Cut solves need infeasibility detection on; everything else
-    follows the configured kernel options."""
-    import dataclasses as _dc
-    return _dc.replace(base, detect_infeas=True, max_iters=100_000)
 
 
 class ReducedCostsSpoke(LagrangianOuterBound):
@@ -385,14 +383,7 @@ class ReducedCostsSpoke(LagrangianOuterBound):
         self.new_rc = False
         # original-space nonant box (static: hoisted from the harvest
         # path so no per-iteration (S, n) device pulls)
-        nonant_idx = np.asarray(self.batch.nonant_idx)
-        S = self.batch.num_scenarios
-        qp = self.batch.qp
-        d = np.broadcast_to(np.asarray(self.batch.d_non),
-                            (S, len(nonant_idx)))
-        l = np.broadcast_to(np.asarray(qp.l), (S, qp.n))[:, nonant_idx] * d
-        u = np.broadcast_to(np.asarray(qp.u), (S, qp.n))[:, nonant_idx] * d
-        self._nonant_lb, self._nonant_ub = l.max(0), u.min(0)
+        self._nonant_lb, self._nonant_ub = self.batch.nonant_box()
 
     def update(self, hub_payload):
         super().update(hub_payload)

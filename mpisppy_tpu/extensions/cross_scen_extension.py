@@ -108,9 +108,10 @@ class CrossScenarioExtension(Extension):
         pass
 
     def post_everything(self):
-        # one final bound attempt so late cuts count
+        # one final bound attempt so late cuts count (respecting the
+        # None = bound-checking-disabled setting, as in miditer)
         self._get_cuts()
-        if self.any_cuts:
+        if self.any_cuts and self.check_bound_iterations is not None:
             self._check_bound()
 
     # parity attribute used by hub traces

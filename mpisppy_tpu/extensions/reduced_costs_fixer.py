@@ -54,17 +54,12 @@ class ReducedCostsFixer(Extension):
         self.verbose = verbose
 
         b = ph.batch
-        nonant_idx = np.asarray(b.nonant_idx)
-        S = b.num_scenarios
-        d = np.broadcast_to(np.asarray(b.d_non), (S, len(nonant_idx)))
-        self._lb0 = (np.broadcast_to(np.asarray(b.qp.l), (S, b.qp.n))
-                     [:, nonant_idx] * d).max(0)
-        self._ub0 = (np.broadcast_to(np.asarray(b.qp.u), (S, b.qp.n))
-                     [:, nonant_idx] * d).min(0)
+        self._lb0, self._ub0 = b.nonant_box()
         self._lb = self._lb0.copy()   # current (possibly tightened)
         self._ub = self._ub0.copy()
-        self.fixed_mask = np.zeros(len(nonant_idx), bool)
-        self._fix_val = np.zeros(len(nonant_idx))
+        N = b.num_nonants
+        self.fixed_mask = np.zeros(N, bool)
+        self._fix_val = np.zeros(N)
         self._best_ob = -math.inf
         self.n_tightened = 0
 

@@ -56,6 +56,7 @@ def _parse_args(module, args=None):
     cfg.lshaped_args()
     cfg.converger_args()
     cfg.wxbar_read_write_args()
+    cfg.proper_bundle_config()
     cfg.multistage()
     cfg.parse_command_line("mpisppy_tpu.generic_cylinders", args)
     cfg.checker()
@@ -86,6 +87,25 @@ def _model_plumbing(cfg, module):
 
 def _build_batch(cfg, module):
     names, kwargs, tree = _model_plumbing(cfg, module)
+    if cfg.get("scenarios_per_bundle"):
+        # proper bundles: PH runs over bundle-EF subproblems
+        # (ref:generic_cylinders.py:316-393 bundle paths)
+        from mpisppy_tpu.utils.pickle_bundle import check_args
+        from mpisppy_tpu.utils.proper_bundler import ProperBundler
+        if tree is not None:
+            raise SystemExit("proper bundles are two-stage only "
+                             "(ref:proper_bundler.py:22); drop "
+                             "--scenarios-per-bundle or the "
+                             "branching factors")
+        check_args(cfg)
+        if cfg.get("num_scens") is None:
+            cfg.quick_assign("num_scens", int, len(names))
+        pb = ProperBundler(module)
+        num_buns = len(names) // int(cfg["scenarios_per_bundle"])
+        kwargs = pb.kw_creator(cfg)
+        names = pb.bundle_names_creator(num_buns, cfg=cfg)
+        specs = [pb.scenario_creator(nm, **kwargs) for nm in names]
+        return batch_mod.from_specs(specs), names, specs
     specs = [module.scenario_creator(nm, **kwargs) for nm in names]
     return batch_mod.from_specs(specs, tree=tree), names, specs
 
@@ -141,6 +161,18 @@ def _do_decomp(cfg, module):
             ext_factories.append(vanilla.cross_scenario_extension(cfg))
         if cfg.get("reduced_costs"):
             ext_factories.append(vanilla.reduced_costs_fixer(cfg))
+        if cfg.get("W_fname") or cfg.get("Xbar_fname"):
+            import functools
+            from mpisppy_tpu.extensions.wxbar_io import WXBarWriter
+            ext_factories.append(functools.partial(
+                WXBarWriter, W_fname=cfg.get("W_fname"),
+                Xbar_fname=cfg.get("Xbar_fname")))
+        if cfg.get("init_W_fname") or cfg.get("init_Xbar_fname"):
+            import functools
+            from mpisppy_tpu.extensions.wxbar_io import WXBarReader
+            ext_factories.append(functools.partial(
+                WXBarReader, init_W_fname=cfg.get("init_W_fname"),
+                init_Xbar_fname=cfg.get("init_Xbar_fname")))
         if len(ext_factories) == 1:
             extensions = ext_factories[0]
         elif ext_factories:

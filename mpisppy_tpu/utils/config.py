@@ -271,6 +271,16 @@ class Config:
         self.add_to_config("W_fname", "output W file", str, None)
         self.add_to_config("Xbar_fname", "output xbar file", str, None)
 
+    def proper_bundle_config(self):
+        """ref:config.py:976-1010."""
+        self.add_to_config("scenarios_per_bundle",
+                           "proper-bundle size (scenarios per bundle)",
+                           int, None)
+        self.add_to_config("pickle_bundles_dir",
+                           "write pickled bundles here", str, None)
+        self.add_to_config("unpickle_bundles_dir",
+                           "read pickled bundles from here", str, None)
+
     def multistage(self):
         """ref:config.py:315-330."""
         self.add_to_config("branching_factors",

@@ -1,0 +1,53 @@
+###############################################################################
+# Scenario/bundle (de)serialization
+# (ref:mpisppy/utils/pickle_bundle.py:21-59).
+#
+# The reference dill-pickles Pyomo bundle models so expensive scenario
+# construction amortizes across runs.  Our scenarios are plain
+# numpy/scipy specs, so standard pickle suffices; helpers keep the
+# reference's API names.  `check_args`/`have_proper_bundles` mirror the
+# reference's Config cross-checks.
+###############################################################################
+from __future__ import annotations
+
+import os
+import pickle
+
+
+def dill_pickle(obj, fname: str):
+    """ref:pickle_bundle.py:21-27 (dill there; specs need only pickle)."""
+    with open(fname, "wb") as f:
+        pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def dill_unpickle(fname: str):
+    """ref:pickle_bundle.py:29-35."""
+    with open(fname, "rb") as f:
+        return pickle.load(f)
+
+
+def check_args(cfg):
+    """ref:pickle_bundle.py:39-52 cross-option validation."""
+    assert cfg.get("pickle_bundles_dir") is None \
+        or cfg.get("unpickle_bundles_dir") is None, \
+        "can't pickle and unpickle bundles in the same run"
+    if cfg.get("pickle_bundles_dir") is not None \
+            or cfg.get("unpickle_bundles_dir") is not None:
+        assert cfg.get("scenarios_per_bundle") is not None, \
+            "bundle pickling needs scenarios_per_bundle"
+
+
+def have_proper_bundles(cfg) -> bool:
+    """ref:pickle_bundle.py:54-59."""
+    return (cfg.get("pickle_bundles_dir") is not None
+            or cfg.get("unpickle_bundles_dir") is not None
+            or cfg.get("scenarios_per_bundle") is not None)
+
+
+def write_spec(spec, dirname: str):
+    os.makedirs(dirname, exist_ok=True)
+    dill_pickle(spec, os.path.join(dirname, f"{spec.name}.pkl"))
+
+
+def read_spec(dirname: str, name: str):
+    return dill_unpickle(os.path.join(dirname, f"{name}.pkl"))

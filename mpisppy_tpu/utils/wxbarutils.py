@@ -1,0 +1,109 @@
+###############################################################################
+# W / x̄ persistence (ref:mpisppy/utils/wxbarutils.py:47-391).
+#
+# The reference writes one csv row per (scenario, variable) for W and
+# per variable for x̄, and reloads them into Pyomo Params to warm-start
+# PH.  Here the natural unit is the device array: W is (S, N), xbar is
+# (num_nodes, N); both csv (reference-compatible shape: name-keyed rows)
+# and npz (fast path, exact) forms are supported, plus full PHState
+# checkpointing so a PH run can resume exactly (the reference has no
+# general checkpointing — SURVEY §5 gap we close).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+# ---- W ------------------------------------------------------------------
+def write_W_to_file(ph, fname: str, sep_files: bool = False):
+    """ref:wxbarutils.py:47-90.  csv rows: scenario_name,slot,value."""
+    W = np.asarray(ph.state.W)
+    names = ph.scenario_names
+    with open(fname, "w") as f:
+        for s, nm in enumerate(names):
+            for i in range(W.shape[1]):
+                f.write(f"{nm},{i},{float(W[s, i])!r}\n")
+
+
+def set_W_from_file(fname: str, ph, disable_check: bool = False):
+    """ref:wxbarutils.py:92-134.  Loads W and installs it into the PH
+    state; checks the p-weighted node mean is ~0 (the PH invariant,
+    ref:wxbarutils.py:224-275 _check_W) unless disabled."""
+    import jax.numpy as jnp
+    W = np.array(np.asarray(ph.state.W))
+    index = {nm: s for s, nm in enumerate(ph.scenario_names)}
+    with open(fname) as f:
+        for line in f:
+            nm, i, v = line.rsplit(",", 2)
+            if nm not in index:
+                raise ValueError(f"unknown scenario {nm!r} in {fname}")
+            W[index[nm], int(i)] = float(v)
+    if not disable_check:
+        Wj = jnp.asarray(W, ph.batch.qp.c.dtype)
+        wbar, _ = ph.batch.node_average(Wj)
+        if float(jnp.max(jnp.abs(wbar))) > 1e-4 * (1.0 + np.abs(W).max()):
+            raise ValueError(
+                "loaded W has nonzero probability-weighted node mean "
+                "(invalid PH duals; pass disable_check to force)")
+    ph.state = dataclasses.replace(
+        ph.state, W=jnp.asarray(W, ph.batch.qp.c.dtype))
+
+
+# ---- xbar ---------------------------------------------------------------
+def write_xbar_to_file(ph, fname: str):
+    """ref:wxbarutils.py:276-296.  csv rows: node,slot,value."""
+    xb = np.asarray(ph.state.xbar_nodes)
+    with open(fname, "w") as f:
+        for nd in range(xb.shape[0]):
+            for i in range(xb.shape[1]):
+                f.write(f"{nd},{i},{float(xb[nd, i])!r}\n")
+
+
+def set_xbar_from_file(fname: str, ph):
+    """ref:wxbarutils.py:298-356."""
+    import jax.numpy as jnp
+    xb = np.array(np.asarray(ph.state.xbar_nodes))
+    with open(fname) as f:
+        for line in f:
+            nd, i, v = line.split(",")
+            xb[int(nd), int(i)] = float(v)
+    batch = ph.batch
+    xbj = jnp.asarray(xb, batch.qp.c.dtype)
+    xbar_scen = jnp.take_along_axis(xbj, batch.node_of_slot, axis=0) \
+        if batch.tree.num_nodes > 1 \
+        else jnp.broadcast_to(xbj[0], ph.state.xbar.shape)
+    ph.state = dataclasses.replace(ph.state, xbar_nodes=xbj,
+                                   xbar=xbar_scen)
+
+
+def ROOT_xbar_npy_serializer(ph, fname: str):
+    """ref:wxbarutils.py:378-388: flat npy of the root-node xbar."""
+    np.save(fname, np.asarray(ph.state.xbar_nodes)[0])
+
+
+# ---- full-state checkpointing (SURVEY §5: reference gap) ----------------
+def save_ph_state(fname: str, ph):
+    """npz snapshot of every PHState leaf + iteration counter; exact
+    resume (same shapes) via load_ph_state."""
+    import jax
+    leaves, treedef = jax.tree.flatten(ph.state)
+    np.savez(fname, _iter=ph._iter,
+             **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+
+def load_ph_state(fname: str, ph):
+    import jax
+    import jax.numpy as jnp
+    data = np.load(fname)
+    leaves, treedef = jax.tree.flatten(ph.state)
+    n = len(leaves)
+    new = [jnp.asarray(data[f"leaf{i}"], leaves[i].dtype) for i in range(n)]
+    for i in range(n):
+        if new[i].shape != leaves[i].shape:
+            raise ValueError(
+                f"checkpoint leaf {i} shape {new[i].shape} != current "
+                f"{leaves[i].shape} (different problem/options?)")
+    ph.state = jax.tree.unflatten(treedef, new)
+    ph._iter = int(data["_iter"])

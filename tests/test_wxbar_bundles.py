@@ -1,0 +1,135 @@
+# W/xbar I/O + PHState checkpointing (ref:utils/wxbar*) and proper
+# bundles (ref:utils/proper_bundler.py, pickle_bundle.py).
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.utils import pickle_bundle, wxbarutils
+from mpisppy_tpu.utils.proper_bundler import ProperBundler, form_bundle_spec
+
+from test_farmer_ef_ph import farmer_specs, scipy_ef_solve
+
+
+def _ph(b, iters=30, conv=0.0):
+    opts = ph_mod.PHOptions(
+        default_rho=1.0, max_iterations=iters, conv_thresh=conv,
+        subproblem_windows=8,
+        pdhg=pdhg.PDHGOptions(tol=1e-7, restart_period=40))
+    return ph_mod.PH(opts, b)
+
+
+def test_w_xbar_roundtrip(tmp_path):
+    b = batch_mod.from_specs(farmer_specs(3))
+    algo = _ph(b, iters=10)
+    algo.Iter0()
+    algo.iterk_loop()
+    wf, xf = str(tmp_path / "w.csv"), str(tmp_path / "xbar.csv")
+    wxbarutils.write_W_to_file(algo, wf)
+    wxbarutils.write_xbar_to_file(algo, xf)
+
+    algo2 = _ph(b, iters=10)
+    algo2.Iter0()
+    wxbarutils.set_W_from_file(wf, algo2)
+    wxbarutils.set_xbar_from_file(xf, algo2)
+    np.testing.assert_allclose(np.asarray(algo2.state.W),
+                               np.asarray(algo.state.W), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(algo2.state.xbar_nodes),
+                               np.asarray(algo.state.xbar_nodes),
+                               rtol=1e-6)
+
+
+def test_w_check_rejects_invalid_duals(tmp_path):
+    b = batch_mod.from_specs(farmer_specs(3))
+    algo = _ph(b, iters=3)
+    algo.Iter0()
+    wf = str(tmp_path / "w.csv")
+    # all-ones W has nonzero node mean: not a valid PH dual vector
+    with open(wf, "w") as f:
+        for nm in algo.scenario_names:
+            for i in range(b.num_nonants):
+                f.write(f"{nm},{i},1.0\n")
+    with pytest.raises(ValueError, match="node mean"):
+        wxbarutils.set_W_from_file(wf, algo)
+    wxbarutils.set_W_from_file(wf, algo, disable_check=True)  # forced
+
+
+def test_warm_start_from_saved_w_converges_faster(tmp_path):
+    b = batch_mod.from_specs(farmer_specs(3))
+    ref = _ph(b, iters=60, conv=5e-2)
+    ref.ph_main()
+    wf = str(tmp_path / "w.csv")
+    wxbarutils.write_W_to_file(ref, wf)
+
+    from mpisppy_tpu.extensions.wxbar_io import WXBarReader
+    import functools
+    warm = ph_mod.PH(
+        ph_mod.PHOptions(default_rho=1.0, max_iterations=60,
+                         conv_thresh=5e-2, subproblem_windows=8,
+                         pdhg=pdhg.PDHGOptions(tol=1e-7,
+                                               restart_period=40)),
+        b, extensions=functools.partial(WXBarReader, init_W_fname=wf))
+    warm.ph_main()
+    assert warm._iter <= ref._iter  # warm duals can't be slower here
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    b = batch_mod.from_specs(farmer_specs(3))
+    algo = _ph(b, iters=8)
+    algo.Iter0()
+    algo.iterk_loop()
+    ck = str(tmp_path / "state.npz")
+    wxbarutils.save_ph_state(ck, algo)
+
+    algo2 = _ph(b, iters=8)
+    algo2.Iter0()
+    wxbarutils.load_ph_state(ck, algo2)
+    assert algo2._iter == algo._iter
+    # one more identical step from the restored state matches exactly
+    s1 = ph_mod.ph_iterk(b, algo.state, algo.options)
+    s2 = ph_mod.ph_iterk(b, algo2.state, algo2.options)
+    np.testing.assert_array_equal(np.asarray(s1.W), np.asarray(s2.W))
+    np.testing.assert_array_equal(np.asarray(s1.solver.x),
+                                  np.asarray(s2.solver.x))
+
+
+def test_bundle_spec_ef_equivalence():
+    """PH over 3 bundles of 2 must reach the same EF objective as the
+    6-scenario EF (the bundle EF identity p_bun f_bun = sum p_i f_i)."""
+    specs = farmer_specs(6)
+    sobj, _ = scipy_ef_solve(specs)
+    bundles = [form_bundle_spec(specs[2 * i:2 * i + 2], f"Bundle_{i}")
+               for i in range(3)]
+    # the bundle batch EF equals the scenario EF
+    bobj, _ = scipy_ef_solve(bundles)
+    assert bobj == pytest.approx(sobj, rel=1e-6)
+    bb = batch_mod.from_specs(bundles)
+    algo = _ph(bb, iters=120, conv=5e-2)
+    conv, eobj, tb = algo.ph_main()
+    assert conv <= 5e-2
+    assert eobj == pytest.approx(sobj, rel=5e-3)
+    np.testing.assert_allclose(algo.first_stage_solution(),
+                               [170.0, 80.0, 250.0], atol=5.0)
+
+
+def test_proper_bundler_api(tmp_path):
+    from mpisppy_tpu.utils.config import Config
+    pb = ProperBundler(farmer)
+    cfg = Config()
+    cfg.quick_assign("num_scens", int, 6)
+    cfg.quick_assign("scenarios_per_bundle", int, 3)
+    names = pb.bundle_names_creator(2, cfg=cfg)
+    assert names == ["Bundle_0_2", "Bundle_3_5"]
+    kw = pb.kw_creator(cfg)
+    b0 = pb.scenario_creator(names[0], **kw)
+    assert b0.name == "Bundle_0_2"
+    assert len(b0.nonant_idx) == 3          # farmer: 3 crops shared
+    # pickle roundtrip
+    pickle_bundle.write_spec(b0, str(tmp_path))
+    b0r = pickle_bundle.read_spec(str(tmp_path), "Bundle_0_2")
+    np.testing.assert_array_equal(b0r.c, b0.c)
+    # plain scenario passthrough
+    s0 = pb.scenario_creator("scen0", **kw)
+    assert s0.name == "scen0"
