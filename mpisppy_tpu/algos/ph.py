@@ -97,9 +97,18 @@ def _xbar_w_conv(batch: ScenarioBatch, st: PHState, beta: float,
     else:
         xsqbar = st.xsqbar
     W = st.W + st.rho * (x_non - xbar)
+    if batch.var_prob is not None:
+        # variable probability: mask W and the convergence metric on
+        # absent (weight-0) slots (ref:mpisppy/spbase.py:398-441
+        # prob0_mask; ref:aph.py W *= prob0_mask)
+        mask = (batch.var_prob > 0.0).astype(W.dtype)
+        W = W * mask
+        conv = jnp.sum(batch.var_prob * jnp.abs(x_non - xbar)) \
+            / batch.num_nonants
+    else:
+        conv = batch.expectation(
+            jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / batch.num_nonants
     z = (1.0 - beta) * st.z + beta * x_non if smoothed else st.z
-    conv = batch.expectation(
-        jnp.sum(jnp.abs(x_non - xbar), axis=-1)) / batch.num_nonants
     return x_non, xbar, xbar_nodes, xsqbar, W, z, conv
 
 

@@ -57,12 +57,16 @@ class ScenarioSpec:
     q: np.ndarray | None = None
     probability: float | None = None  # None -> uniform
     integer: np.ndarray | None = None  # bool over all n columns
+    # per-slot nonant weights for variable-probability problems
+    # (ref:mpisppy/spbase.py:398-441): weight 0 marks a slot absent from
+    # this scenario (admm wrappers); None -> ordinary probabilities.
+    var_prob: np.ndarray | None = None  # (N,) weights
 
 
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["qp", "d_col", "d_row", "d_non", "p", "nonant_idx",
-                 "node_of_slot", "integer_slot"],
+                 "node_of_slot", "integer_slot", "var_prob"],
     meta_fields=["tree", "num_real"],
 )
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +96,11 @@ class ScenarioBatch:
     integer_slot: Array
     tree: ScenarioTree
     num_real: int
+    # (S, N) per-(scenario, slot) nonant weights, or None for ordinary
+    # probability weighting (ref:mpisppy/spbase.py:398-441 prob_coeff).
+    # Weight 0 marks a slot ABSENT from that scenario (admm wrappers);
+    # reductions then average only over the scenarios that carry it.
+    var_prob: Array | None = None
 
     @property
     def num_scenarios(self) -> int:
@@ -126,6 +135,8 @@ class ScenarioBatch:
         jit over a sharded scenario axis the sums become cross-device
         all-reduces automatically.
         """
+        if weights is None:
+            weights = self.var_prob  # may still be None
         w = self.p[:, None] if weights is None else weights
         tiny = jnp.asarray(1e-30, vals.dtype)
         if self.tree.num_nodes == 1:
@@ -280,7 +291,20 @@ def from_specs(specs: list[ScenarioSpec],
     if specs[0].integer is not None:
         integer = np.asarray(specs[0].integer, bool)
 
+    var_prob = None
+    if any(sp.var_prob is not None for sp in specs):
+        # var_prob entries are ABSOLUTE per-(scenario, slot)
+        # probabilities (they replace p in the reductions), so specs
+        # without one default to their scenario probability —
+        # the reference's prob_coeff-defaults-to-probability semantics
+        # (ref:mpisppy/spbase.py:398-441)
+        var_prob = jnp.asarray(np.stack([
+            np.full(len(nonant_idx), probs[i]) if sp.var_prob is None
+            else np.asarray(sp.var_prob, np.float64)
+            for i, sp in enumerate(specs)]), dtype)
+
     return ScenarioBatch(
+        var_prob=var_prob,
         qp=qp,
         d_col=d_col_j,
         d_row=d_row_j,
@@ -324,6 +348,14 @@ def pad_to_multiple(batch: ScenarioBatch, multiple: int) -> ScenarioBatch:
         bl=pad_leading(qp.bl, 2), bu=pad_leading(qp.bu, 2),
         l=pad_leading(qp.l, 2), u=pad_leading(qp.u, 2),
     )
+    var_prob = batch.var_prob
+    if var_prob is not None:
+        # padded rows get ZERO weights: var-prob reductions use the
+        # weights directly (not p), so nonzero pads would enter the
+        # node-average denominators
+        var_prob = jnp.concatenate(
+            [var_prob, jnp.zeros((pad,) + var_prob.shape[1:],
+                                 var_prob.dtype)], axis=0)
     return dataclasses.replace(
         batch,
         qp=qp,
@@ -332,4 +364,5 @@ def pad_to_multiple(batch: ScenarioBatch, multiple: int) -> ScenarioBatch:
         d_non=pad_leading(batch.d_non, 2),
         p=jnp.concatenate([batch.p, jnp.zeros(pad, batch.p.dtype)]),
         node_of_slot=pad_leading(batch.node_of_slot, 2),
+        var_prob=var_prob,
     )

@@ -80,4 +80,6 @@ def shard_batch(batch, mesh: Mesh):
         nonant_idx=jax.device_put(batch.nonant_idx, repl),
         node_of_slot=put(batch.node_of_slot, 2),
         integer_slot=jax.device_put(batch.integer_slot, repl),
+        var_prob=None if batch.var_prob is None
+        else jax.device_put(batch.var_prob, shard),
     )

@@ -42,10 +42,13 @@ def form_bundle_spec(members: list[ScenarioSpec],
     n_oth = len(oth)
     k = len(members)
 
-    p_i = np.array([1.0 if m.probability is None else m.probability
-                    for m in members])
-    if any(m.probability is None for m in members):
-        p_i = np.ones(k)              # uniform members: weights 1/k
+    nones = [m.probability is None for m in members]
+    if any(nones) and not all(nones):
+        raise ValueError(
+            "form_bundle_spec: members mix explicit and None (uniform) "
+            "probabilities; make them consistent before bundling")
+    p_i = np.ones(k) if all(nones) else \
+        np.array([m.probability for m in members])
     p_bun = p_i.sum()
     w = p_i / p_bun
 

@@ -1,0 +1,144 @@
+# admmWrapper / stoch_admmWrapper: consensus ADMM as (multistage) PH
+# with variable probabilities (ref:utils/admmWrapper.py,
+# utils/stoch_admmWrapper.py; tests ref:test_admmWrapper.py).
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.utils.admmWrapper import AdmmWrapper
+from mpisppy_tpu.utils.stoch_admmWrapper import Stoch_AdmmWrapper
+
+
+def _region_creator(name):
+    """Two regions sharing consensus variable 'f':
+      A: min 1/2 f^2 - 2 f + yA      , 0 <= yA <= 1,  f - yA <= 3
+      B: min 1/2 f^2 - 6 f + 2 yB    , yB >= f - 3  (as f - yB <= 3)
+    merged optimum: f* = 4 (d/df of f^2 - 8f), yA* = 1, yB* = 1.
+    """
+    if name == "A":
+        spec = ScenarioSpec(
+            name="A",
+            c=np.array([-2.0, 1.0]),
+            q=np.array([1.0, 0.0]),
+            A=np.array([[1.0, -1.0]]),
+            bl=np.array([-np.inf]), bu=np.array([3.0]),
+            l=np.array([0.0, 0.0]), u=np.array([10.0, 1.0]),
+            nonant_idx=np.array([0], np.int32),
+        )
+        return spec, ["f", "yA"]
+    spec = ScenarioSpec(
+        name="B",
+        c=np.array([-6.0, 2.0]),
+        q=np.array([1.0, 0.0]),
+        A=np.array([[1.0, -1.0]]),
+        bl=np.array([-np.inf]), bu=np.array([3.0]),
+        l=np.array([0.0, 0.0]), u=np.array([10.0, 10.0]),
+        nonant_idx=np.array([0], np.int32),
+    )
+    return spec, ["f", "yB"]
+
+
+def _merged_optimum():
+    # min over (f, yA, yB): f^2 - 8f + yA + 2 yB
+    #   s.t. f - yA <= 3, f - yB <= 3, boxes
+    from scipy.optimize import minimize
+    res = minimize(
+        lambda v: v[0] ** 2 - 8 * v[0] + v[1] + 2 * v[2],
+        x0=np.array([1.0, 0.5, 0.5]),
+        bounds=[(0, 10), (0, 1), (0, 10)],
+        constraints=[{"type": "ineq",
+                      "fun": lambda v: 3 - v[0] + v[1]},
+                     {"type": "ineq",
+                      "fun": lambda v: 3 - v[0] + v[2]}])
+    assert res.success
+    return res.fun, res.x
+
+
+def test_admm_wrapper_consensus():
+    wrapper = AdmmWrapper({}, ["A", "B"], _region_creator,
+                          {"A": ["f"], "B": ["f"]})
+    b = wrapper.make_batch()
+    assert b.var_prob is not None
+    # weight 1/2 for the shared consensus var in both regions
+    np.testing.assert_allclose(np.asarray(b.var_prob)[:, 0], [0.5, 0.5])
+
+    opts = ph_mod.PHOptions(default_rho=2.0, max_iterations=200,
+                            conv_thresh=1e-4, subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7,
+                                                  restart_period=40))
+    algo = ph_mod.PH(opts, b)
+    conv, eobj, tb = algo.ph_main()
+    assert conv <= 1e-4
+    fstar_obj, xstar = _merged_optimum()
+    # PH expectation = (1/2) * sum_r (2 * f_r) = the admm sum
+    assert eobj == pytest.approx(fstar_obj, abs=5e-2)
+    f_consensus = float(algo.state.xbar_nodes[0, 0])
+    assert f_consensus == pytest.approx(xstar[0], abs=1e-2)
+
+
+def test_admm_wrapper_missing_var_raises():
+    with pytest.raises(RuntimeError, match="not in the model"):
+        AdmmWrapper({}, ["A", "B"], _region_creator,
+                    {"A": ["f", "ghost"], "B": ["f"]})
+
+
+def _stoch_region_creator(snm, rnm, d=None):
+    """Two scenarios scaling region B's linear consensus reward:
+    first-stage z (cost 1, z >= f - 2 as f - z <= 2), consensus f."""
+    dval = {"S0": -6.0, "S1": -10.0}[snm]
+    if rnm == "A":
+        spec = ScenarioSpec(
+            name=f"{snm}_A",
+            c=np.array([0.25, -2.0]),   # cols: [z, f] (cheap z: the
+            #                             optimum is strict, z* = 4)
+            q=np.array([0.0, 1.0]),
+            A=np.array([[-1.0, 1.0]]),  # f - z <= 2
+            bl=np.array([-np.inf]), bu=np.array([2.0]),
+            l=np.zeros(2), u=np.array([10.0, 10.0]),
+            nonant_idx=np.array([0], np.int32),
+        )
+        return spec, ["z", "f"]
+    spec = ScenarioSpec(
+        name=f"{snm}_B",
+        c=np.array([0.25, dval]),
+        q=np.array([0.0, 1.0]),
+        A=np.array([[-1.0, 1.0]]),
+        bl=np.array([-np.inf]), bu=np.array([2.0]),
+        l=np.zeros(2), u=np.array([10.0, 10.0]),
+        nonant_idx=np.array([0], np.int32),
+    )
+    return spec, ["z", "f"]
+
+
+def test_stoch_admm_wrapper_tree_and_consensus():
+    wrapper = Stoch_AdmmWrapper(
+        {}, ["A", "B"], ["S0", "S1"], _stoch_region_creator,
+        {"A": ["f"], "B": ["f"]})
+    assert wrapper.split_admm_stoch_subproblem_scenario_name(
+        "ADMM_STOCH_S0_B") == ("S0", "B")
+    b = wrapper.make_batch()
+    assert b.tree.num_stages == 3
+    assert b.num_scenarios == 4          # 2 scenarios x 2 regions
+    assert b.num_nonants == 2            # [z (stage-1), f (stage-2)]
+
+    opts = ph_mod.PHOptions(default_rho=2.0, max_iterations=300,
+                            conv_thresh=2e-4, subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7,
+                                                  restart_period=40))
+    algo = ph_mod.PH(opts, b)
+    conv, eobj, tb = algo.ph_main()
+    assert conv <= 2e-4
+    # per-scenario consensus f*: minimizes f^2 + (-2 + dval) f with
+    # f <= z + 2; z shared across scenarios (cost 2 total across
+    # regions after the R-scaling cancels in expectation)
+    xb = np.asarray(algo.state.xbar_nodes)
+    f_s0 = xb[1, 1]
+    f_s1 = xb[2, 1]
+    # S1's reward is steeper, so its consensus flow must be larger
+    assert f_s1 > f_s0 + 0.2
+    # z is a ROOT quantity: equal view everywhere, and binding for S1
+    z = xb[0, 0]
+    assert f_s1 <= z + 2.0 + 1e-3
