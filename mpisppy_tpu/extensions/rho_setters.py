@@ -101,3 +101,97 @@ class CoeffRho(Extension):
         batch = self.opt.batch
         cost = _orig_cost_per_slot(batch)
         _set_rho(self.opt, self.multiplier * np.maximum(cost, 1e-6))
+
+
+class MultRhoUpdater(Extension):
+    """Multiplicative rho schedule
+    (ref:mpisppy/extensions/mult_rho_updater.py:32): every
+    `mult_rho_update_interval` iterations after `_first_iter`, rho *=
+    `mult_rho_update_factor` (stopping after `_last_iter`)."""
+
+    def __init__(self, ph, mult_rho_update_factor: float = 2.0,
+                 mult_rho_update_interval: int = 2,
+                 first_iter: int = 2, last_iter: int | None = None):
+        super().__init__(ph)
+        self.factor = mult_rho_update_factor
+        self.interval = mult_rho_update_interval
+        self.first_iter = first_iter
+        # None = never stop (the reference default)
+        self.last_iter = last_iter
+
+    def miditer(self):
+        ph = self.opt
+        it = ph._iter
+        if (self.first_iter <= it
+                and (self.last_iter is None or it <= self.last_iter)
+                and (it - self.first_iter) % self.interval == 0):
+            _set_rho(ph, np.asarray(ph.state.rho) * self.factor)
+
+
+class SensiRho(Extension):
+    """KKT-sensitivity-based rho
+    (ref:mpisppy/extensions/sensi_rho.py:15,75): per-slot rho from the
+    order-stat aggregation of per-scenario |nonant sensitivities| at
+    the iter0 solves, scaled by `sensi_rho_multiplier`."""
+
+    def __init__(self, ph, sensi_rho_multiplier: float = 1.0,
+                 order_stat: float = 0.5):
+        super().__init__(ph)
+        self.multiplier = sensi_rho_multiplier
+        self.order_stat = order_stat
+
+    def post_iter0(self):
+        from mpisppy_tpu.utils.gradient import order_stat_aggregate
+        from mpisppy_tpu.utils.nonant_sensitivities import (
+            nonant_sensitivities,
+        )
+        ph = self.opt
+        sens = np.abs(nonant_sensitivities(ph.batch, ph.state.solver))
+        p = np.asarray(ph.batch.p, np.float64)
+        rho = order_stat_aggregate(sens, p, self.order_stat)
+        rho = np.maximum(rho, 1e-6) * self.multiplier
+        _set_rho(ph, rho)
+
+
+class ReducedCostsRho(Extension):
+    """rho from expected |reduced costs| of the LP-LR solve
+    (ref:mpisppy/extensions/reduced_costs_rho.py:15) — identical
+    machinery to SensiRho here (both read the solve's reduced costs),
+    kept as its own class for the reference's option surface with its
+    own multiplier."""
+
+    def __init__(self, ph, rc_rho_multiplier: float = 1.0):
+        super().__init__(ph)
+        self._inner = SensiRho(ph, sensi_rho_multiplier=rc_rho_multiplier)
+
+    def post_iter0(self):
+        self._inner.post_iter0()
+
+
+class Gradient_extension(Extension):
+    """Dynamic gradient-based rho
+    (ref:mpisppy/extensions/gradient_extension.py:18, base
+    ref:dyn_rho_base.py:22): recompute the WW-heuristic rho every
+    `grad_rho_update_interval` iterations from the current iterates
+    (Find_Rho with fresh gradient costs), gated after iter 1."""
+
+    def __init__(self, ph, grad_order_stat: float = 0.5,
+                 grad_rho_update_interval: int = 5,
+                 indep_denom: bool = False,
+                 grad_rho_relative_bound: float = 1e3):
+        super().__init__(ph)
+        from mpisppy_tpu.utils.gradient import Find_Rho
+        self.interval = grad_rho_update_interval
+        self.indep_denom = indep_denom
+        self._finder = Find_Rho(ph, {
+            "grad_order_stat": grad_order_stat,
+            "grad_rho_relative_bound": grad_rho_relative_bound})
+
+    def miditer(self):
+        ph = self.opt
+        if ph._iter < 2 or (ph._iter - 2) % self.interval != 0:
+            return
+        self._finder.c = None  # refresh gradient costs at the iterates
+        rho = self._finder.compute_rho(indep_denom=self.indep_denom)
+        rho = np.maximum(rho, 1e-6)
+        _set_rho(ph, rho)

@@ -50,6 +50,8 @@ def _parse_args(module, args=None):
     cfg.xhatxbar_args()
     cfg.xhatshuffle_args()
     cfg.slama_args()
+    cfg.gradient_args()
+    cfg.dynamic_rho_args()
     cfg.reduced_costs_args()
     cfg.ph_ob_args()
     cfg.cross_scenario_cuts_args()
@@ -161,6 +163,34 @@ def _do_decomp(cfg, module):
             ext_factories.append(vanilla.cross_scenario_extension(cfg))
         if cfg.get("reduced_costs"):
             ext_factories.append(vanilla.reduced_costs_fixer(cfg))
+        if cfg.get("grad_rho"):
+            import functools
+            from mpisppy_tpu.extensions.rho_setters import (
+                Gradient_extension,
+            )
+            ext_factories.append(functools.partial(
+                Gradient_extension,
+                grad_order_stat=cfg.get("grad_order_stat", 0.5),
+                grad_rho_update_interval=cfg.get(
+                    "grad_rho_update_interval", 5),
+                grad_rho_relative_bound=cfg.get(
+                    "grad_rho_relative_bound", 1e3)))
+        if cfg.get("sensi_rho"):
+            import functools
+            from mpisppy_tpu.extensions.rho_setters import SensiRho
+            ext_factories.append(functools.partial(
+                SensiRho,
+                sensi_rho_multiplier=cfg.get("sensi_rho_multiplier",
+                                             1.0)))
+        if cfg.get("mult_rho"):
+            import functools
+            from mpisppy_tpu.extensions.rho_setters import MultRhoUpdater
+            ext_factories.append(functools.partial(
+                MultRhoUpdater,
+                mult_rho_update_factor=cfg.get("mult_rho_update_factor",
+                                               2.0),
+                mult_rho_update_interval=cfg.get(
+                    "mult_rho_update_interval", 2)))
         if cfg.get("W_fname") or cfg.get("Xbar_fname"):
             import functools
             from mpisppy_tpu.extensions.wxbar_io import WXBarWriter
@@ -180,8 +210,13 @@ def _do_decomp(cfg, module):
             import functools
             extensions = functools.partial(MultiExtension,
                                            ext_classes=ext_factories)
+        rho_setter = None
+        if cfg.get("rho_file_in"):
+            from mpisppy_tpu.utils.gradient import Set_Rho
+            rho_setter = Set_Rho(cfg).rho_setter
         hub = vanilla.ph_hub(cfg, batch, scenario_names=names,
-                             converger=converger, extensions=extensions)
+                             converger=converger, extensions=extensions,
+                             rho_setter=rho_setter)
     spokes = []
     if not cfg.get("lshaped_hub") and not cfg.get("aph_hub"):
         if cfg.get("cross_scenario_cuts"):
@@ -218,6 +253,13 @@ def _do_decomp(cfg, module):
     if cfg.get("solution_base_name"):
         wheel.write_first_stage_solution(
             cfg["solution_base_name"] + ".csv")
+    if cfg.get("rho_file_out") \
+            and getattr(wheel.opt, "state", None) is not None \
+            and hasattr(wheel.opt.state, "rho"):
+        import numpy as _np
+        from mpisppy_tpu.utils.rho_utils import rhos_to_csv
+        rhos_to_csv(_np.asarray(wheel.opt.state.rho),
+                    cfg["rho_file_out"])
     for rank0, nm in enumerate(names):
         module.scenario_denouement(0, nm, specs[rank0])
 

@@ -195,6 +195,38 @@ class Config:
         self.add_to_config("xhatshuffle_iter_step",
                            "candidates per sync", int, 4)
 
+    def gradient_args(self):
+        """ref:config.py:821-872."""
+        self.add_to_config("grad_rho", "use gradient-based dynamic rho",
+                           bool, False)
+        self.add_to_config("grad_order_stat",
+                           "rho order statistic (0=min,0.5=mean,1=max)",
+                           float, 0.5)
+        self.add_to_config("grad_rho_update_interval",
+                           "iterations between rho recomputation", int, 5)
+        self.add_to_config("grad_rho_relative_bound",
+                           "denominator floor bound", float, 1e3)
+        self.add_to_config("rho_file_in",
+                           "csv of per-slot rhos (ID,rho header)", str,
+                           None)
+        self.add_to_config("rho_file_out", "write computed rhos here",
+                           str, None)
+
+    def dynamic_rho_args(self):
+        """ref:config.py:873-910."""
+        self.add_to_config("sensi_rho",
+                           "rho from iter0 KKT sensitivities", bool,
+                           False)
+        self.add_to_config("sensi_rho_multiplier",
+                           "sensitivity rho multiplier", float, 1.0)
+        self.add_to_config("mult_rho", "multiplicative rho schedule",
+                           bool, False)
+        self.add_to_config("mult_rho_update_factor", "rho factor",
+                           float, 2.0)
+        self.add_to_config("mult_rho_update_interval",
+                           "iterations between rho multiplications",
+                           int, 2)
+
     def reduced_costs_args(self):
         """ref:config.py:539-600."""
         self.add_to_config("reduced_costs",

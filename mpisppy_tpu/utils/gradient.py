@@ -1,0 +1,162 @@
+###############################################################################
+# Gradient-based cost and rho (ref:mpisppy/utils/gradient.py:34-267,
+# ref:mpisppy/utils/find_rho.py:38-357).
+#
+# Find_Grad: the reference fixes nonants at x̂, solves every scenario,
+# and evaluates the objective gradient via PyomoNLP (pynumero AD).  Our
+# objectives are explicit quadratics, so the gradient at the solve IS
+# c + q x — one batched fixed-nonant solve, no AD plumbing.  Stored as
+# the NEGATED gradient ("gradient cost", ref:gradient.py:85-90).
+#
+# Find_Rho: the WW-heuristic rho from first-order conditions
+# (ref:find_rho.py:152-225):  rho[s,i] = |cost[s,i] - W[s,i]| / denom,
+# with denom either per-scenario |x - xbar| (clipped to its max /
+# tolerance, ref:find_rho.py:73-95) or the scenario-independent
+# E[max(|x - xbar|, 1)] (ref:find_rho.py:117-150), then aggregated
+# across scenarios with the grad_order_stat triangular interpolation
+# (0 = min, 0.5 = p-mean, 1 = max).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import pdhg
+
+Array = jax.Array
+E1_TOLERANCE = 1e-5  # ref:spbase E1_tolerance default
+
+
+@jax.jit
+def _grad_costs(batch: ScenarioBatch, solver_x: Array) -> Array:
+    """(S, N) negated objective gradients at the nonant columns, in
+    ORIGINAL space (ref:gradient.py:55-90 compute_grad)."""
+    qp = batch.qp
+    grad = qp.c + qp.q * solver_x
+    return -(grad[..., batch.nonant_idx] / batch.d_non)
+
+
+def find_grad_cost(batch: ScenarioBatch, xhat: Array,
+                   opts: pdhg.PDHGOptions | None = None) -> np.ndarray:
+    """Batched analog of Find_Grad.find_grad_cost
+    (ref:gradient.py:95-130): fix nonants at x̂, solve, grab gradients."""
+    opts = opts or pdhg.PDHGOptions(tol=1e-6, max_iters=100_000)
+    qp = batch.with_fixed_nonants(jnp.asarray(xhat, batch.qp.c.dtype))
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    fixed_batch = dataclasses.replace(batch, qp=qp)
+    return np.asarray(_grad_costs(fixed_batch, st.x), np.float64)
+
+
+def w_denom(x_non: np.ndarray, xbar: np.ndarray) -> np.ndarray:
+    """(S, N) per-scenario denominator |x - xbar|, zeros replaced by the
+    row max (ref:find_rho.py:73-95)."""
+    d = np.abs(np.asarray(x_non) - np.asarray(xbar))
+    dmax = np.maximum(d.max(axis=-1, keepdims=True), E1_TOLERANCE)
+    return np.where(d <= E1_TOLERANCE, dmax, d)
+
+
+def prox_denom(x_non: np.ndarray, xbar: np.ndarray) -> np.ndarray:
+    """2 (x - xbar)^2, floored like w_denom (ref:find_rho.py:97-115)."""
+    d = np.asarray(x_non) - np.asarray(xbar)
+    d = 2.0 * d * d
+    dmax = np.maximum(d.max(axis=-1, keepdims=True), E1_TOLERANCE)
+    return np.where(d <= E1_TOLERANCE, dmax, d)
+
+
+def grad_denom(batch: ScenarioBatch, x_non: np.ndarray,
+               xbar: np.ndarray,
+               grad_rho_relative_bound: float = 1e3) -> np.ndarray:  # noqa: D401
+    """(N,) scenario-independent denominator E[max(|x - xbar|, 1)]
+    (ref:find_rho.py:117-150)."""
+    p = np.asarray(batch.p, np.float64)
+    d = np.maximum(np.abs(np.asarray(x_non) - np.asarray(xbar)), 1.0)
+    g = (p[:, None] * d).sum(0)
+    return np.maximum(g, 1.0 / grad_rho_relative_bound)
+
+
+def order_stat_aggregate(rho_scen: np.ndarray, p: np.ndarray,
+                         alpha: float) -> np.ndarray:
+    """Aggregate per-scenario rhos to one per slot with the triangular
+    order statistic (ref:find_rho.py:186-224)."""
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(
+            f"grad_order_stat must be in [0,1] (0=min, 0.5=mean, "
+            f"1=max); got {alpha}")
+    rmin = rho_scen.min(axis=0)
+    rmax = rho_scen.max(axis=0)
+    rmean = (p[:, None] * rho_scen).sum(0) / max(p.sum(), 1e-30)
+    if alpha == 0.5:
+        return rmean
+    if alpha == 0.0:
+        return rmin
+    if alpha == 1.0:
+        return rmax
+    if alpha < 0.5:
+        return rmin + alpha * 2.0 * (rmean - rmin)
+    return (2.0 * rmean - rmax) + alpha * 2.0 * (rmax - rmean)
+
+
+class Find_Rho:
+    """ref:mpisppy/utils/find_rho.py:38.  Needs a PH driver with a
+    state (post Iter0 at least) and per-(scenario, slot) gradient costs
+    (from find_grad_cost, or the driver's own iterates)."""
+
+    def __init__(self, ph, cfg=None):
+        self.ph = ph
+        self.cfg = cfg or {}
+        self.c: np.ndarray | None = None  # (S, N) gradient costs
+
+    def _get(self, name, default):
+        try:
+            v = self.cfg.get(name, default)
+        except AttributeError:
+            v = getattr(self.cfg, name, default)
+        return default if v is None else v
+
+    def compute_rho(self, indep_denom: bool = False,
+                    denom_kind: str = "w") -> np.ndarray:
+        """(N,) rho from the WW heuristic (ref:find_rho.py:152-225).
+        denom_kind: 'w' (|x - xbar|) or 'prox' (2(x - xbar)^2);
+        indep_denom selects the scenario-independent grad denominator."""
+        ph = self.ph
+        batch = ph.batch
+        st = ph.state
+        x_non = np.asarray(batch.nonants(st.solver.x), np.float64)
+        xbar = np.asarray(st.xbar, np.float64)
+        if self.c is None:
+            # costs at the current iterates (the xhat-file path of the
+            # reference is find_grad_cost)
+            self.c = np.asarray(
+                _grad_costs(batch, st.solver.x), np.float64)
+        W = np.asarray(st.W, np.float64)
+        if indep_denom:
+            denom = grad_denom(
+                batch, x_non, xbar,
+                self._get("grad_rho_relative_bound", 1e3))[None, :]
+        elif denom_kind == "prox":
+            denom = prox_denom(x_non, xbar)
+        else:
+            denom = w_denom(x_non, xbar)
+        rho_scen = np.abs((self.c - W) / denom)
+        p = np.asarray(batch.p, np.float64)
+        return order_stat_aggregate(rho_scen, p,
+                                    float(self._get("grad_order_stat",
+                                                    0.5)))
+
+
+class Set_Rho:
+    """rho_setter plumbing from a saved rho file
+    (ref:find_rho.py:246-288)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def rho_setter(self, batch) -> np.ndarray:
+        from mpisppy_tpu.utils.rho_utils import rhos_from_csv
+        fname = self.cfg.get("rho_file_in") \
+            if hasattr(self.cfg, "get") else self.cfg["rho_file_in"]
+        return rhos_from_csv(fname, batch.num_nonants)

@@ -1,0 +1,32 @@
+###############################################################################
+# Per-nonant sensitivities (ref:mpisppy/utils/nonant_sensitivities.py,
+# backed by a vendored interior-point KKT interface,
+# ref:mpisppy/utils/kkt/interface.py:20+).
+#
+# The reference solves each scenario's relaxation and extracts
+# d(objective)/d(nonant) sensitivities from the KKT system.  The
+# batched PDHG solve already produces exactly that object: the
+# ORIGINAL-space reduced cost  rc = (c + q x + A'y) / d_col  at an
+# (approximately) optimal primal-dual pair IS the objective sensitivity
+# to moving the nonant off its current value (zero for strictly
+# interior basic variables).  One batched solve replaces the per-rank
+# interior-point factorizations.
+###############################################################################
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import pdhg
+
+Array = jax.Array
+
+
+def nonant_sensitivities(batch: ScenarioBatch,
+                         solver: pdhg.PDHGState) -> np.ndarray:
+    """(S, N) objective sensitivities of the nonants at a solve."""
+    qp = batch.qp
+    rc = qp.c + qp.q * solver.x + qp.rmatvec(solver.y)
+    return np.asarray(rc[..., batch.nonant_idx] / batch.d_non,
+                      np.float64)

@@ -1,0 +1,27 @@
+###############################################################################
+# rho csv helpers (ref:mpisppy/utils/rho_utils.py:1-44): rows of
+# "slot,value" (the reference keys by variable name; slots are the
+# TPU-native variable identity).
+###############################################################################
+from __future__ import annotations
+
+import numpy as np
+
+
+def rhos_to_csv(rho: np.ndarray, fname: str):
+    with open(fname, "w") as f:
+        f.write("ID,rho\n")
+        for i, v in enumerate(np.asarray(rho)):
+            f.write(f"{i},{float(v)!r}\n")
+
+
+def rhos_from_csv(fname: str, num_nonants: int) -> np.ndarray:
+    rho = np.ones(num_nonants)
+    with open(fname) as f:
+        header = f.readline()
+        if "rho" not in header:
+            raise ValueError(f"{fname}: missing 'ID,rho' header")
+        for line in f:
+            i, v = line.split(",")
+            rho[int(i)] = float(v)
+    return rho

@@ -1,0 +1,130 @@
+# Gradient costs, Find_Rho, rho csv, prox_approx cuts, sensitivities,
+# and the dynamic-rho extensions (ref:utils/gradient.py, find_rho.py,
+# prox_approx.py, nonant_sensitivities.py; tests
+# ref:test_gradient_rho.py).
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.utils import gradient, rho_utils
+from mpisppy_tpu.utils.nonant_sensitivities import nonant_sensitivities
+from mpisppy_tpu.utils.prox_approx import ProxApproxManager, tangent_cut
+
+from test_farmer_ef_ph import farmer_specs
+
+
+def _ph(b, iters=20):
+    opts = ph_mod.PHOptions(
+        default_rho=1.0, max_iterations=iters, conv_thresh=0.0,
+        subproblem_windows=8,
+        pdhg=pdhg.PDHGOptions(tol=1e-7, restart_period=40))
+    algo = ph_mod.PH(opts, b)
+    algo.Iter0()
+    algo.iterk_loop()
+    return algo
+
+
+def test_grad_cost_is_negated_objective_gradient():
+    b = batch_mod.from_specs(farmer_specs(3))
+    xhat = np.array([170.0, 80.0, 250.0])
+    c = gradient.find_grad_cost(b, xhat)
+    assert c.shape == (3, 3)
+    # farmer first-stage cost: 150, 230, 260 $/acre (pure linear), so
+    # the negated gradient is -cost for every scenario
+    np.testing.assert_allclose(c, -np.array([[150.0, 230.0, 260.0]] * 3),
+                               rtol=1e-4)
+
+
+def test_order_stat_aggregate_limits():
+    rho = np.array([[1.0, 4.0], [3.0, 8.0]])
+    p = np.array([0.5, 0.5])
+    np.testing.assert_allclose(
+        gradient.order_stat_aggregate(rho, p, 0.0), [1.0, 4.0])
+    np.testing.assert_allclose(
+        gradient.order_stat_aggregate(rho, p, 1.0), [3.0, 8.0])
+    np.testing.assert_allclose(
+        gradient.order_stat_aggregate(rho, p, 0.5), [2.0, 6.0])
+    # triangular interpolation stays within [min, max]
+    mid = gradient.order_stat_aggregate(rho, p, 0.25)
+    assert ((mid >= [1.0, 4.0]) & (mid <= [3.0, 8.0])).all()
+    with pytest.raises(ValueError):
+        gradient.order_stat_aggregate(rho, p, 1.5)
+
+
+def test_find_rho_positive_and_finite():
+    b = batch_mod.from_specs(farmer_specs(3))
+    algo = _ph(b, iters=5)
+    finder = gradient.Find_Rho(algo, {"grad_order_stat": 0.5})
+    rho = finder.compute_rho()
+    assert rho.shape == (3,)
+    assert np.isfinite(rho).all() and (rho >= 0).all()
+    rho_i = finder.compute_rho(indep_denom=True)
+    assert np.isfinite(rho_i).all() and (rho_i >= 0).all()
+
+
+def test_rho_csv_roundtrip(tmp_path):
+    rho = np.array([1.5, 2.0, 0.25])
+    f = str(tmp_path / "rho.csv")
+    rho_utils.rhos_to_csv(rho, f)
+    back = rho_utils.rhos_from_csv(f, 3)
+    np.testing.assert_allclose(back, rho)
+    from mpisppy_tpu.utils.gradient import Set_Rho
+    setter = Set_Rho({"rho_file_in": f})
+    b = batch_mod.from_specs(farmer_specs(3))
+    np.testing.assert_allclose(setter.rho_setter(b), rho)
+
+
+def test_prox_approx_cuts_tighten():
+    mgr = ProxApproxManager(1, tol=1e-3)
+    # tangent cut math: underestimates x^2 everywhere, exact at x_pt
+    s, b = tangent_cut(np.array(2.0))
+    xs = np.linspace(-5, 5, 101)
+    assert (s * xs + b <= xs * xs + 1e-12).all()
+    assert s * 2.0 + b == pytest.approx(4.0)
+    # iterating add_cut at a point drives the epigraph gap under tol
+    x = 3.7
+    for _ in range(30):
+        if mgr.add_cut(0, x) == 0:
+            break
+    assert x * x - mgr.evaluate(0, x) <= 1e-3
+    # and the approximation is still a global underestimator
+    for xx in np.linspace(-6, 6, 25):
+        assert mgr.evaluate(0, float(xx)) <= xx * xx + 1e-9
+
+
+def test_sensitivities_shape_and_magnitude():
+    b = batch_mod.from_specs(farmer_specs(3))
+    opts = pdhg.PDHGOptions(tol=1e-7, max_iters=100_000)
+    st = pdhg.solve(b.qp, opts)
+    sens = nonant_sensitivities(b, st)
+    assert sens.shape == (3, 3)
+    assert np.isfinite(sens).all()
+
+
+def test_dynamic_rho_extensions_run():
+    import functools
+    from mpisppy_tpu.extensions.rho_setters import (
+        Gradient_extension, MultRhoUpdater, SensiRho,
+    )
+    b = batch_mod.from_specs(farmer_specs(3))
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=8,
+                            conv_thresh=0.0, subproblem_windows=8,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7))
+    # MultRhoUpdater doubles rho on schedule
+    algo = ph_mod.PH(opts, b, extensions=functools.partial(
+        MultRhoUpdater, mult_rho_update_factor=2.0,
+        mult_rho_update_interval=2))
+    algo.ph_main()
+    assert float(np.asarray(algo.state.rho)[0]) > 1.0
+    # SensiRho sets rho from iter0 sensitivities
+    algo2 = ph_mod.PH(opts, b, extensions=SensiRho)
+    algo2.ph_main()
+    assert not np.allclose(np.asarray(algo2.state.rho), 1.0)
+    # Gradient_extension updates rho mid-run without breaking PH
+    algo3 = ph_mod.PH(opts, b, extensions=functools.partial(
+        Gradient_extension, grad_rho_update_interval=3))
+    conv, eobj, tb = algo3.ph_main()
+    assert np.isfinite(eobj)
+    assert (np.asarray(algo3.state.rho) > 0).all()
