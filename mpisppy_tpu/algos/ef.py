@@ -130,6 +130,35 @@ class ExtensiveForm:
         xs = np.asarray(self._state.x) * self.ef.scaling.d_col
         return xs.reshape(len(self.specs), self.ef.n_per_scen)
 
+    def fix_root_nonants(self, xhat_root: np.ndarray):
+        """Collapse the ROOT-stage nonant boxes at xhat in every
+        scenario block (the EF analog of _fix_root_nonants,
+        ref:mpisppy/spopt.py:686-725).  Call before
+        solve_extensive_form."""
+        import dataclasses as _dc
+        root_slots = np.nonzero(self.ef.tree.slot_stage == 1)[0]
+        cols = np.asarray(self.ef.nonant_idx)[root_slots]
+        xhat_root = np.asarray(xhat_root, np.float64)
+        if xhat_root.shape[-1] != len(cols):
+            raise ValueError(
+                f"xhat has {xhat_root.shape[-1]} values; the root "
+                f"stage has {len(cols)} nonant slots")
+        S = len(self.specs)
+        n = self.ef.n_per_scen
+        d = np.asarray(self.ef.scaling.d_col)
+        l = np.array(np.asarray(self.ef.qp.l), np.float64)
+        u = np.array(np.asarray(self.ef.qp.u), np.float64)
+        for s in range(S):
+            idx = s * n + cols
+            xs = xhat_root / d[idx]
+            l[idx] = xs
+            u[idx] = xs
+        self.ef = _dc.replace(
+            self.ef, qp=_dc.replace(
+                self.ef.qp,
+                l=jnp.asarray(l, self.ef.qp.l.dtype),
+                u=jnp.asarray(u, self.ef.qp.u.dtype)))
+
     def get_objective_value(self) -> float:
         """EF objective in original space (ref:opt/ef.py:106)."""
         x = self.x

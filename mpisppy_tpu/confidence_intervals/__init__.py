@@ -1,0 +1,1 @@
+from mpisppy_tpu.confidence_intervals import ciutils  # noqa: F401

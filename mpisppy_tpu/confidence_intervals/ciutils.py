@@ -1,0 +1,159 @@
+###############################################################################
+# CI utilities (ref:mpisppy/confidence_intervals/ciutils.py:141-445).
+#
+# gap_estimators is the statistical core: sample n scenarios, solve the
+# induced approximate problem (EF) for (z_n*, x*), evaluate the
+# candidate x̂ AND x* on every sampled scenario, and form the
+# Mak-Morton-Wood gap estimator
+#   G = E_n[f(x̂, xi) - f(x*, xi)],  s^2 = (E[g^2] - G^2)/(1 - ||p||^2)
+# (ref:ciutils.py:404-427).  On TPU both evaluations are ONE batched
+# fixed-nonant solve each over the sampled batch, and the EF is the
+# batched EF kernel — no Gurobi, no Amalgamator process machinery.
+###############################################################################
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.ops import pdhg
+
+
+def write_xhat(xhat, path: str = "xhat.npy"):
+    """ref:ciutils.py:156-161 — flat npy of the root xhat."""
+    np.save(path, np.asarray(xhat, np.float64))
+
+
+def read_xhat(path: str = "xhat.npy", delete_file: bool = False):
+    """ref:ciutils.py:163-173."""
+    xhat = np.load(path)
+    if delete_file:
+        import os
+        os.remove(path)
+    return xhat
+
+
+def branching_factors_from_numscens(numscens: int,
+                                    num_stages: int) -> list[int]:
+    """Even branching factors whose product is >= numscens
+    (ref:ciutils.py:126-139)."""
+    if num_stages == 2:
+        return [numscens]
+    stages = num_stages - 1
+    b = max(2, int(math.ceil(numscens ** (1.0 / stages))))
+    return [b] * stages
+
+
+def scalable_branching_factors(numscens: int,
+                               ref_bfs) -> list[int]:
+    """Scale the model's branching factors so the product is close to
+    (>=) numscens while keeping the shape (ref:ciutils.py:104-124)."""
+    ref_bfs = list(ref_bfs)
+    prod = int(np.prod(ref_bfs))
+    if prod >= numscens:
+        return ref_bfs
+    fac = (numscens / prod) ** (1.0 / len(ref_bfs))
+    return [max(b, int(math.ceil(b * fac))) for b in ref_bfs]
+
+
+def correcting_numeric(G: float, objfct: float,
+                       relative_error: bool = True,
+                       threshold: float = 1e-4) -> float:
+    """Clip small negative G from numerical error (ref:ciutils.py:191-211,
+    minimization)."""
+    crit = threshold * abs(objfct) if relative_error else threshold
+    if G <= -crit:
+        global_toc(f"WARNING: gap estimator has the wrong sign: {G}",
+                   True)
+        return G
+    return max(0.0, G)
+
+
+def _sample_specs(module, scenario_names, cfg):
+    kw = module.kw_creator(cfg)
+    return [module.scenario_creator(nm, **kw) for nm in scenario_names]
+
+
+def gap_estimators(xhat_one, module, scenario_names, cfg,
+                   ArRP: int = 1,
+                   opts: pdhg.PDHGOptions | None = None,
+                   verbose: bool = False) -> dict:
+    """G and s at x̂ from one sampled batch (ref:ciutils.py:214-433;
+    two-stage — the multistage path lives in sample_tree).
+
+    Returns {"G", "s", "seed", "zn_star", "xstar"}; the pooled ArRP>1
+    path returns only {"G", "s", "seed"} (matching the reference,
+    ref:ciutils.py:291-319)."""
+    from mpisppy_tpu.algos import xhat as xhat_mod
+    from mpisppy_tpu.algos.ef import build_ef
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.ops import boxqp
+    from mpisppy_tpu.utils.sputils import extract_num
+    import jax.numpy as jnp
+
+    opts = opts or pdhg.PDHGOptions(tol=1e-7, max_iters=200_000)
+    start = extract_num(scenario_names[0])
+
+    if ArRP > 1:
+        # pooled estimators (ref:ciutils.py:291-319)
+        n = len(scenario_names)
+        if n % ArRP != 0:
+            n -= n % ArRP
+        import copy
+        sub_cfg = copy.deepcopy(cfg)
+        # each pool is its own sample: uniform probabilities over the
+        # pool (the reference reassigns _mpisppy_probability,
+        # ref:mmw_ci.py:134-135)
+        sub_cfg.quick_assign("num_scens", int, n // ArRP)
+        Gs, ss = [], []
+        for k in range(ArRP):
+            part = scenario_names[k * (n // ArRP):(k + 1) * (n // ArRP)]
+            est = gap_estimators(xhat_one, module, part, sub_cfg,
+                                 ArRP=1, opts=opts)
+            Gs.append(est["G"])
+            ss.append(est["s"])
+        return {"G": float(np.mean(Gs)),
+                "s": float(np.linalg.norm(ss) / np.sqrt(n // ArRP)),
+                "seed": start + n}
+
+    # the sample IS the distribution: uniform probabilities over the
+    # sampled scenarios (ref:ciutils.py:344-349 quick_assign num_scens
+    # and _mpisppy_probability on an ephemeral cfg)
+    import copy
+    cfg = copy.deepcopy(cfg)
+    cfg.quick_assign("num_scens", int, len(scenario_names))
+    specs = _sample_specs(module, scenario_names, cfg)
+    b = batch_mod.from_specs(specs)
+
+    # solve the sampled EF for (zn_star, x*)
+    efp = build_ef(specs)
+    st = pdhg.solve(efp.qp, opts, pdhg.init_state(efp.qp, opts))
+    n0 = specs[0].c.shape[0]
+    nonant_idx = np.asarray(specs[0].nonant_idx)
+    d0 = np.asarray(efp.scaling.d_col)[:n0] \
+        if getattr(efp, "scaling", None) is not None else np.ones(n0)
+    xstar = (np.asarray(st.x)[:n0] * d0)[nonant_idx]
+
+    # evaluate xhat and xstar on every sampled scenario (batched)
+    ev_xhat = xhat_mod.evaluate(b, jnp.asarray(np.asarray(xhat_one)),
+                                opts)
+    ev_xstar = xhat_mod.evaluate(b, jnp.asarray(xstar), opts)
+    f_hat = np.asarray(ev_xhat.per_scenario, np.float64)
+    f_star = np.asarray(ev_xstar.per_scenario, np.float64)
+    p = np.asarray(b.p, np.float64)
+
+    gaps = f_hat - f_star
+    G = float(np.dot(gaps, p))
+    ssq = float(np.dot(gaps * gaps, p))
+    prob_sqnorm = float(np.dot(p, p))
+    sample_var = max((ssq - G * G) / max(1.0 - prob_sqnorm, 1e-12), 0.0)
+    s = math.sqrt(sample_var)
+
+    obj_at_xhat = float(np.dot(f_hat, p))
+    G = correcting_numeric(G, objfct=obj_at_xhat,
+                           relative_error=abs(obj_at_xhat) > 1)
+    if verbose:
+        global_toc(f"gap estimator: G={G:.6g} s={s:.6g}", True)
+    return {"G": G, "s": s, "seed": start + len(scenario_names),
+            "zn_star": float(np.dot(f_star, p)), "xstar": xstar}

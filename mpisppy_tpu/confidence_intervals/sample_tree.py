@@ -1,0 +1,102 @@
+###############################################################################
+# Sampled subtrees for multistage evaluation
+# (ref:mpisppy/confidence_intervals/sample_tree.py:23-318).
+#
+# SampleSubtree builds a sampled multistage batch (module must expose
+# make_tree(branching_factors) and a seedable scenario_creator — e.g.
+# models.aircond's start_seed) and solves its EF, optionally with the
+# first `fixed_stages` stages pinned at given xhats.
+#
+# walking_tree_xhats (ref:sample_tree.py:191-260): a feasible,
+# nonanticipative policy for EVERY non-leaf node.  The reference
+# resolves one subtree per node recursively; here ONE EF solve of the
+# sampled tree with the root fixed already produces nonanticipative
+# per-node values — we read the per-node averages of the EF solution
+# (exact consensus by the EF's nonant rows) as the node xhats.
+###############################################################################
+from __future__ import annotations
+
+import numpy as np
+
+from mpisppy_tpu.ops import pdhg
+
+
+class SampleSubtree:
+    """ref:sample_tree.py:23."""
+
+    def __init__(self, module, xhats, branching_factors, seed: int,
+                 cfg, opts: pdhg.PDHGOptions | None = None):
+        self.module = module
+        self.xhats = None if xhats is None or len(xhats) == 0 \
+            else np.asarray(xhats, np.float64)
+        self.branching_factors = tuple(int(b) for b in branching_factors)
+        self.seed = seed
+        self.cfg = cfg
+        self.opts = opts or pdhg.PDHGOptions(tol=1e-7, max_iters=200_000)
+        self.EF_obj = None
+        self.ef = None
+
+    def run(self):
+        from mpisppy_tpu.algos.ef import ExtensiveForm
+        import math
+        kw = dict(self.module.kw_creator(self.cfg))
+        kw["branching_factors"] = self.branching_factors
+        if "start_seed" in _kw_names(self.module):
+            kw["start_seed"] = self.seed
+        num = math.prod(self.branching_factors)
+        names = self.module.scenario_names_creator(num)
+        tree = self.module.make_tree(self.branching_factors)
+        self.ef = ExtensiveForm({"tol": self.opts.tol,
+                                 "max_iters": self.opts.max_iters},
+                                names, self.module.scenario_creator, kw,
+                                tree=tree)
+        if self.xhats is not None:
+            # pin the leading stage slots at the given xhats
+            self.ef.fix_root_nonants(self.xhats)
+        st = self.ef.solve_extensive_form()
+        self.EF_obj = self.ef.get_objective_value()
+        self._state = st
+        return self.EF_obj
+
+
+def _kw_names(module):
+    import inspect
+    return set(inspect.signature(module.scenario_creator).parameters)
+
+
+def walking_tree_xhats(module, xhat_one, branching_factors, seed, cfg,
+                       opts: pdhg.PDHGOptions | None = None):
+    """Per-node xhats for a sampled tree with the root pinned at
+    xhat_one (ref:sample_tree.py:191-260).  Returns
+    (xhats (num_nodes, N), next_seed)."""
+    st = SampleSubtree(module, xhat_one, branching_factors, seed, cfg,
+                       opts)
+    st.run()
+    batch_tree = st.ef.ef.tree
+    sol = st.ef.x                             # (S, n) original space
+    nonant_idx = np.asarray(st.ef.ef.nonant_idx)
+    x_non = sol[:, nonant_idx]
+    # pin the root block to xhat_one, average the rest per node
+    node_of_slot = batch_tree.node_of_slot()
+    N = x_non.shape[1]
+    num_nodes = batch_tree.num_nodes
+    xhats = np.zeros((num_nodes, N))
+    counts = np.zeros((num_nodes, N))
+    for s in range(x_non.shape[0]):
+        for i in range(N):
+            xhats[node_of_slot[s, i], i] += x_non[s, i]
+            counts[node_of_slot[s, i], i] += 1.0
+    xhats = np.divide(xhats, np.maximum(counts, 1.0))
+    n_root = int(np.asarray(xhat_one).shape[-1])
+    xhats[0, :n_root] = np.asarray(xhat_one)
+    next_seed = seed + _number_of_nodes(branching_factors)
+    return xhats, next_seed
+
+
+def _number_of_nodes(branching_factors) -> int:
+    """ref:sputils number_of_nodes: non-leaf node count."""
+    total, acc = 1, 1
+    for b in branching_factors[:-1]:
+        acc *= b
+        total += acc
+    return total

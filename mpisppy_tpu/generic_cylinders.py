@@ -173,6 +173,7 @@ def _do_decomp(cfg, module):
                 grad_order_stat=cfg.get("grad_order_stat", 0.5),
                 grad_rho_update_interval=cfg.get(
                     "grad_rho_update_interval", 5),
+                indep_denom=cfg.get("grad_rho_indep_denom", False),
                 grad_rho_relative_bound=cfg.get(
                     "grad_rho_relative_bound", 1e3)))
         if cfg.get("sensi_rho"):

@@ -206,6 +206,9 @@ class Config:
                            "iterations between rho recomputation", int, 5)
         self.add_to_config("grad_rho_relative_bound",
                            "denominator floor bound", float, 1e3)
+        self.add_to_config("grad_rho_indep_denom",
+                           "use the scenario-independent denominator",
+                           bool, False)
         self.add_to_config("rho_file_in",
                            "csv of per-slot rhos (ID,rho header)", str,
                            None)

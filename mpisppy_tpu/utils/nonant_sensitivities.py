@@ -25,8 +25,12 @@ Array = jax.Array
 
 def nonant_sensitivities(batch: ScenarioBatch,
                          solver: pdhg.PDHGState) -> np.ndarray:
-    """(S, N) objective sensitivities of the nonants at a solve."""
-    qp = batch.qp
-    rc = qp.c + qp.q * solver.x + qp.rmatvec(solver.y)
-    return np.asarray(rc[..., batch.nonant_idx] / batch.d_non,
+    """(S, N) objective sensitivities of the nonants at a solve —
+    exactly the W=0 reduced costs (one shared implementation of the
+    scaling/sign convention: algos.lagrangian.nonant_reduced_costs)."""
+    import jax.numpy as jnp
+    from mpisppy_tpu.algos.lagrangian import nonant_reduced_costs
+    W0 = jnp.zeros((batch.num_scenarios, batch.num_nonants),
+                   batch.qp.c.dtype)
+    return np.asarray(nonant_reduced_costs(batch, W0, solver),
                       np.float64)

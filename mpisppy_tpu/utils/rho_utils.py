@@ -21,7 +21,20 @@ def rhos_from_csv(fname: str, num_nonants: int) -> np.ndarray:
         header = f.readline()
         if "rho" not in header:
             raise ValueError(f"{fname}: missing 'ID,rho' header")
-        for line in f:
-            i, v = line.split(",")
-            rho[int(i)] = float(v)
+        for lineno, line in enumerate(f, start=2):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                i_str, v_str = line.split(",")
+                i, v = int(i_str), float(v_str)
+            except ValueError as e:
+                raise ValueError(
+                    f"{fname}:{lineno}: expected 'ID,rho', got "
+                    f"{line!r}") from e
+            if not 0 <= i < num_nonants:
+                raise ValueError(
+                    f"{fname}:{lineno}: slot {i} out of range "
+                    f"[0, {num_nonants})")
+            rho[i] = v
     return rho
