@@ -88,7 +88,6 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
     from mpisppy_tpu.algos import xhat as xhat_mod
     from mpisppy_tpu.algos.ef import build_ef
     from mpisppy_tpu.core import batch as batch_mod
-    from mpisppy_tpu.ops import boxqp
     from mpisppy_tpu.utils.sputils import extract_num
     import jax.numpy as jnp
 
@@ -96,20 +95,18 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
     start = extract_num(scenario_names[0])
 
     if ArRP > 1:
-        # pooled estimators (ref:ciutils.py:291-319)
+        # pooled estimators (ref:ciutils.py:291-319); the recursive
+        # ArRP=1 call pins each pool's probabilities itself
         n = len(scenario_names)
         if n % ArRP != 0:
-            n -= n % ArRP
-        import copy
-        sub_cfg = copy.deepcopy(cfg)
-        # each pool is its own sample: uniform probabilities over the
-        # pool (the reference reassigns _mpisppy_probability,
-        # ref:mmw_ci.py:134-135)
-        sub_cfg.quick_assign("num_scens", int, n // ArRP)
+            raise ValueError(
+                f"{n} scenarios is not a multiple of ArRP={ArRP}; "
+                "silently dropping the tail would desynchronize "
+                "seed accounting (the reference raises too)")
         Gs, ss = [], []
         for k in range(ArRP):
             part = scenario_names[k * (n // ArRP):(k + 1) * (n // ArRP)]
-            est = gap_estimators(xhat_one, module, part, sub_cfg,
+            est = gap_estimators(xhat_one, module, part, cfg,
                                  ArRP=1, opts=opts)
             Gs.append(est["G"])
             ss.append(est["s"])
@@ -139,6 +136,17 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
     ev_xhat = xhat_mod.evaluate(b, jnp.asarray(np.asarray(xhat_one)),
                                 opts)
     ev_xstar = xhat_mod.evaluate(b, jnp.asarray(xstar), opts)
+    # an infeasible candidate has NO defined gap: per_scenario would
+    # hold the arbitrary objective of a frozen iterate
+    if not bool(ev_xhat.feasible):
+        raise RuntimeError(
+            "gap_estimators: xhat is infeasible for some sampled "
+            "scenario (recourse evaluation failed); the gap is "
+            "undefined for this candidate")
+    if not bool(ev_xstar.feasible):
+        raise RuntimeError(
+            "gap_estimators: the sampled-EF solution failed its own "
+            "recourse evaluation (solver tolerance issue)")
     f_hat = np.asarray(ev_xhat.per_scenario, np.float64)
     f_star = np.asarray(ev_xstar.per_scenario, np.float64)
     p = np.asarray(b.p, np.float64)

@@ -77,15 +77,14 @@ def walking_tree_xhats(module, xhat_one, branching_factors, seed, cfg,
     nonant_idx = np.asarray(st.ef.ef.nonant_idx)
     x_non = sol[:, nonant_idx]
     # pin the root block to xhat_one, average the rest per node
-    node_of_slot = batch_tree.node_of_slot()
+    node_of_slot = np.asarray(batch_tree.node_of_slot())
     N = x_non.shape[1]
     num_nodes = batch_tree.num_nodes
     xhats = np.zeros((num_nodes, N))
     counts = np.zeros((num_nodes, N))
-    for s in range(x_non.shape[0]):
-        for i in range(N):
-            xhats[node_of_slot[s, i], i] += x_non[s, i]
-            counts[node_of_slot[s, i], i] += 1.0
+    cols = np.broadcast_to(np.arange(N), node_of_slot.shape)
+    np.add.at(xhats, (node_of_slot, cols), x_non)
+    np.add.at(counts, (node_of_slot, cols), 1.0)
     xhats = np.divide(xhats, np.maximum(counts, 1.0))
     n_root = int(np.asarray(xhat_one).shape[-1])
     xhats[0, :n_root] = np.asarray(xhat_one)
@@ -94,9 +93,13 @@ def walking_tree_xhats(module, xhat_one, branching_factors, seed, cfg,
 
 
 def _number_of_nodes(branching_factors) -> int:
-    """ref:sputils number_of_nodes: non-leaf node count."""
+    """TOTAL node-id count consumed by node-seeded samplers (aircond
+    keys its RandomState by node_idx over ALL stages including the
+    leaves, ref:aircond.py:44-75) — advancing by less would overlap the
+    seed streams of consecutive sampled trees and correlate the
+    'independent' samples."""
     total, acc = 1, 1
-    for b in branching_factors[:-1]:
+    for b in branching_factors:
         acc *= b
         total += acc
     return total

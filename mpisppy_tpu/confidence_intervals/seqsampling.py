@@ -39,7 +39,8 @@ class SeqSampling:
         self.xhat_gen_kwargs = cfg.get("xhat_gen_kwargs", {}) or {}
         self.confidence_level = cfg.get("confidence_level", 0.95)
         self.ArRP = cfg.get("ArRP", 1)
-        self.kf_xhat = cfg.get("kf_Gs", 1)
+        self.kf_Gs = cfg.get("kf_Gs", 1)
+        self.kf_xhat = cfg.get("kf_xhat", 1)
         # BM parameters (ref:seqsampling.py defaults)
         self.BM_h = cfg.get("BM_h", 1.75)
         self.BM_hprime = cfg.get("BM_hprime", 0.5)
@@ -145,15 +146,30 @@ class SeqSampling:
             nk_m1 = nk
             lower_bound_k = self.sample_size(k, Gk, sk, nk_m1)
             mk = int(math.floor(mult * lower_bound_k))
-            xhat_names = module.scenario_names_creator(
-                mk, start=self.ScenCount)
-            self.ScenCount += mk
+            # kf_xhat: resample the candidate only every kf_xhat
+            # iterations; otherwise extend the previous sample
+            # (ref:seqsampling.py:447-460 reuse branches)
+            if k % self.kf_xhat == 0 or len(xhat_names) == 0:
+                xhat_names = module.scenario_names_creator(
+                    mk, start=self.ScenCount)
+                self.ScenCount += mk
+            elif mk > len(xhat_names):
+                extra = mk - len(xhat_names)
+                xhat_names = xhat_names + module.scenario_names_creator(
+                    extra, start=self.ScenCount)
+                self.ScenCount += extra
             xhat_k = self.xhat_generator(xhat_names,
                                          **self.xhat_gen_kwargs)
             nk = self.ArRP * int(math.ceil(lower_bound_k / self.ArRP))
-            est_names = module.scenario_names_creator(
-                nk, start=self.ScenCount)
-            self.ScenCount += nk
+            if k % self.kf_Gs == 0 or nk > nk_m1 * 2:
+                est_names = module.scenario_names_creator(
+                    nk, start=self.ScenCount)
+                self.ScenCount += nk
+            elif nk > len(est_names):
+                extra = nk - len(est_names)
+                est_names = est_names + module.scenario_names_creator(
+                    extra, start=self.ScenCount)
+                self.ScenCount += extra
             est = ciutils.gap_estimators(xhat_k, module, est_names,
                                          self.cfg, ArRP=self.ArRP)
             Gk, sk = est["G"], est["s"]

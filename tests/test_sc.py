@@ -1,0 +1,98 @@
+# Schur-complement interior point (ref:mpisppy/opt/sc.py; tests
+# ref:mpisppy/tests/test_sc.py — serial and mpirun there, one batched
+# program here).
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos.sc import SchurComplement, SCOptions
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer, sslp
+
+from test_farmer_ef_ph import farmer_specs, scipy_ef_solve
+
+
+def test_sc_farmer_matches_ef():
+    specs = farmer_specs(3)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    sc = SchurComplement(SCOptions(max_iter=60, tol=1e-8), b)
+    res = sc.solve()
+    assert res["converged"]
+    assert res["objective"] == pytest.approx(sobj, rel=1e-5)
+    np.testing.assert_allclose(res["x"], [170.0, 80.0, 250.0], atol=0.1)
+
+
+def test_sc_farmer_quadratic():
+    # add a diagonal quadratic cost on the first-stage acres: SC handles
+    # QPs natively (a strict superset of the reference's LP-only MA27
+    # usage on these problems)
+    specs = farmer_specs(3)
+    specs = [dataclasses.replace(
+        sp, q=np.concatenate([np.full(3, 0.1),
+                              np.zeros(sp.c.shape[0] - 3)]))
+        for sp in specs]
+    sobj, sx = scipy_qp_oracle(specs)
+    b = batch_mod.from_specs(specs)
+    sc = SchurComplement(SCOptions(max_iter=60, tol=1e-8), b)
+    res = sc.solve()
+    assert res["converged"]
+    assert res["objective"] == pytest.approx(sobj, rel=1e-4)
+
+
+def scipy_qp_oracle(specs):
+    """EF QP via scipy.optimize.minimize (SLSQP is fine at this size)."""
+    from mpisppy_tpu.algos.ef import build_ef
+    efp = build_ef(specs, scale=False)
+    qp = efp.qp
+    c = np.asarray(qp.c, np.float64)
+    q = np.asarray(qp.q, np.float64)
+    A = np.asarray(qp.A, np.float64)
+    bl = np.asarray(qp.bl, np.float64)
+    bu = np.asarray(qp.bu, np.float64)
+    l = np.asarray(qp.l, np.float64)
+    u = np.asarray(qp.u, np.float64)
+    from scipy.optimize import Bounds, LinearConstraint, minimize
+    n = len(c)
+    x0 = np.clip(np.zeros(n), l, np.minimum(u, 1e3))
+    res = minimize(
+        lambda v: c @ v + 0.5 * v @ (q * v),
+        x0, jac=lambda v: c + q * v,
+        hess=lambda v: np.diag(q),
+        bounds=Bounds(l, u),
+        constraints=[LinearConstraint(A, bl, bu)],
+        method="trust-constr",
+        options={"maxiter": 3000, "gtol": 1e-10, "xtol": 1e-12})
+    assert res.status in (1, 2), res.message
+    return res.fun, res.x
+
+
+def test_sc_sslp_lp_relaxation():
+    inst = sslp.synthetic_instance(3, 9, seed=2)
+    names = sslp.scenario_names_creator(4)
+    specs = [sslp.scenario_creator(nm, instance=inst, num_scens=4,
+                                   lp_relax=True) for nm in names]
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    # degenerate set-cover vertices need a deep central path: tol 1e-9
+    sc = SchurComplement(SCOptions(max_iter=150, tol=1e-9), b)
+    res = sc.solve()
+    assert res["converged"]
+    assert res["objective"] == pytest.approx(sobj, rel=1e-4)
+
+
+def test_sc_rejects_integer_and_multistage():
+    inst = sslp.synthetic_instance(3, 9, seed=2)
+    specs = [sslp.scenario_creator("Scenario0", instance=inst,
+                                   num_scens=1, lp_relax=False)]
+    b = batch_mod.from_specs(specs)
+    with pytest.raises(ValueError, match="continuous"):
+        SchurComplement(SCOptions(), b)
+
+    from mpisppy_tpu.models import hydro
+    hspecs = [hydro.scenario_creator(nm)
+              for nm in hydro.scenario_names_creator(9)]
+    hb = batch_mod.from_specs(hspecs, tree=hydro.make_tree())
+    with pytest.raises(ValueError, match="two-stage"):
+        SchurComplement(SCOptions(), hb)
