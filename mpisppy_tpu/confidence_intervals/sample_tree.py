@@ -41,7 +41,7 @@ class SampleSubtree:
         import math
         kw = dict(self.module.kw_creator(self.cfg))
         kw["branching_factors"] = self.branching_factors
-        if "start_seed" in _kw_names(self.module):
+        if _accepts_start_seed(self.module):
             kw["start_seed"] = self.seed
         num = math.prod(self.branching_factors)
         names = self.module.scenario_names_creator(num)
@@ -59,9 +59,17 @@ class SampleSubtree:
         return self.EF_obj
 
 
-def _kw_names(module):
+def _accepts_start_seed(module) -> bool:
+    """True if scenario_creator can receive start_seed — either as an
+    explicit named parameter or through a **kw VAR_KEYWORD catch-all
+    (aircond takes it via **kw; dropping it there would make every
+    sampled subtree identical, ref:sample_tree.py:137-138)."""
     import inspect
-    return set(inspect.signature(module.scenario_creator).parameters)
+    params = inspect.signature(module.scenario_creator).parameters
+    if "start_seed" in params:
+        return True
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in params.values())
 
 
 def walking_tree_xhats(module, xhat_one, branching_factors, seed, cfg,

@@ -53,6 +53,10 @@ class SeqSampling:
         self.BPL_c0 = cfg.get("BPL_c0", 50)
         self.BPL_c1 = cfg.get("BPL_c1", 10)
         self.BPL_n0min = cfg.get("BPL_n0min", 50)
+        # default growth_function is linear in k (ref:seqsampling.py
+        # growth_function default = (k-1))
+        self.growth_function = cfg.get("growth_function", None) \
+            or (lambda k: k - 1)
 
         if stopping_criterion == "BM":
             self.stop_criterion = self.bm_stopping_criterion
@@ -106,7 +110,8 @@ class SeqSampling:
         return int(math.ceil(lower))
 
     def bpl_fsp_sampsize(self, k, G, s, nk_m1):
-        return int(math.ceil(self.BPL_c0 + self.BPL_c1 * math.log(k ** 2)))
+        return int(math.ceil(self.BPL_c0
+                             + self.BPL_c1 * self.growth_function(k)))
 
     def stochastic_sampsize(self, k, G, s, nk_m1):
         if k == 1:
@@ -176,6 +181,16 @@ class SeqSampling:
             global_toc(f"seq sampling iter {k}: n={nk} G={Gk:.5g} "
                        f"s={sk:.5g}", True)
 
+        # The coverage guarantee only holds if the stopping rule was
+        # actually met; at k == maxit the reference raises RuntimeError
+        # (ref:seqsampling.py maxit guard).  We flag instead so callers
+        # can still inspect the partial result, but loudly.
+        converged = not self.stop_criterion(Gk, sk, nk)
+        if not converged:
+            global_toc(f"WARNING: sequential sampling hit maxit={maxit} "
+                       "without satisfying the stopping criterion; the "
+                       "returned CI has NO coverage guarantee", True)
+
         # CI on the gap at the final candidate (ref theory: width from
         # the stopping rule's parameters)
         if self.stopping_criterion == "BM":
@@ -184,7 +199,8 @@ class SeqSampling:
             t = scipy.stats.t.ppf(self.confidence_level, nk - 1)
             upper = Gk + t * sk / math.sqrt(nk) + 1.0 / math.sqrt(nk)
         return {"T": k, "Candidate_solution": xhat_k,
-                "CI": [0.0, float(upper)], "G": Gk, "s": sk, "nk": nk}
+                "CI": [0.0, float(upper)], "G": Gk, "s": sk, "nk": nk,
+                "converged": converged}
 
 
 class IndepScens_SeqSampling(SeqSampling):

@@ -145,3 +145,32 @@ def test_zhat4xhat_multistage():
         xhat_root, 3, cfg, aircond, InitSeed=11)
     assert zhats.shape == (3,)
     assert np.isfinite(zhats).all()
+
+
+def test_sample_tree_seed_varies_samples():
+    # regression: aircond takes start_seed via **kw; the seed must
+    # reach the creator or every sampled subtree is identical
+    from mpisppy_tpu.confidence_intervals.sample_tree import SampleSubtree
+    cfg = Config()
+    objs = [SampleSubtree(aircond, None, (2, 2), seed, cfg).run()
+            for seed in (100, 5000)]
+    assert objs[0] != objs[1]
+
+
+def test_zhat4xhat_multistage_nonzero_variance():
+    # regression: the t-interval is only valid if samples vary
+    cfg = Config()
+    cfg.quick_assign("branching_factors", list, [2, 2])
+    zhats, _ = zhat4xhat.evaluate_sample_trees(
+        np.array([200.0, 0.0]), 3, cfg, aircond, InitSeed=11)
+    assert np.std(zhats) > 0.0
+
+
+def test_seq_sampling_converged_flag():
+    # unmet stopping criterion at maxit must be flagged
+    cfg = _cfg(10, BM_h=1.75, BM_hprime=0.0, BM_eps=0.01,
+               BM_eps_prime=1e-8, confidence_level=0.9)
+    bad_gen = lambda names, **kw: np.array([0.0, 0.0, 0.0])
+    seq = SeqSampling(farmer, bad_gen, cfg, stopping_criterion="BM")
+    res = seq.run(maxit=2)
+    assert res["converged"] is False
