@@ -11,6 +11,14 @@
 # same batched PDHG kernel (a single "scenario" of size S*n).  It is the
 # correctness oracle for the decomposition algorithms: PH's converged
 # objective must match the EF objective.
+#
+# Assembly is SPARSE by default beyond toy scale: the block-diagonal +
+# two-nonzero link-row structure is exactly ELL-friendly (every scenario
+# row keeps its within-block width; link rows have width 2), so the EF
+# A is an ops.sparse.EllMatrix and HBM holds O(nnz), not O(m * S * n).
+# The reference gets the same effect through Pyomo->Gurobi sparse
+# ingestion (ref:mpisppy/utils/sputils.py:143-357); a dense (m, S*n)
+# assembly caps the oracle at ~10 scenarios (round-2 review, weak #2).
 ###############################################################################
 from __future__ import annotations
 
@@ -39,7 +47,11 @@ class EFProblem:
 def build_ef(specs: list[ScenarioSpec],
              tree: ScenarioTree | None = None,
              dtype=jnp.float32,
-             scale: bool = True) -> EFProblem:
+             scale: bool = True,
+             sparse: bool | None = None) -> EFProblem:
+    """Assemble the extensive form.  `sparse=None` auto-selects: ELL
+    whenever any scenario matrix is scipy-sparse or the dense (m, S*n)
+    block would exceed ~2e7 entries; tiny oracles stay dense."""
     S = len(specs)
     n = specs[0].c.shape[0]
     nonant_idx = np.asarray(specs[0].nonant_idx, np.int64)
@@ -73,25 +85,54 @@ def build_ef(specs: list[ScenarioSpec],
 
     m_block = sum(sp.A.shape[0] for sp in specs)
     m = m_block + len(link_rows)
-    A = np.zeros((m, S * n))
     bl = np.empty(m)
     bu = np.empty(m)
+
+    import scipy.sparse as sps
+    any_sparse = any(sps.issparse(sp.A) for sp in specs)
+    if sparse is None:
+        sparse = any_sparse or m * S * n > 2e7
+
+    if sparse:
+        blocks = [sps.csr_matrix(np.asarray(sp.A) if not sps.issparse(sp.A)
+                                 else sp.A) for sp in specs]
+        parts = [sps.block_diag(blocks, format="csr")]
+        if link_rows:
+            rows = np.repeat(np.arange(len(link_rows)), 2)
+            cols = np.empty(2 * len(link_rows), np.int64)
+            data = np.tile([1.0, -1.0], len(link_rows))
+            for r_, (s0, s, i) in enumerate(link_rows):
+                cols[2 * r_] = s0 * n + nonant_idx[i]
+                cols[2 * r_ + 1] = s * n + nonant_idx[i]
+            parts.append(sps.csr_matrix((data, (rows, cols)),
+                                        shape=(len(link_rows), S * n)))
+        from mpisppy_tpu.ops import sparse as sparse_mod
+        A = sparse_mod.ell_from_scipy(sps.vstack(parts).tocsr(), dtype)
+    else:
+        A = np.zeros((m, S * n))
     r = 0
     for s, sp in enumerate(specs):
         ms = sp.A.shape[0]
-        # scipy-sparse scenario matrices densify into the EF block
-        As = sp.A.toarray() if hasattr(sp.A, "toarray") else sp.A
-        A[r:r + ms, s * n:(s + 1) * n] = As
+        if not sparse:
+            As = sp.A.toarray() if hasattr(sp.A, "toarray") else sp.A
+            A[r:r + ms, s * n:(s + 1) * n] = As
         bl[r:r + ms] = sp.bl
         bu[r:r + ms] = sp.bu
         r += ms
     for (s0, s, i) in link_rows:
-        A[r, s0 * n + nonant_idx[i]] = 1.0
-        A[r, s * n + nonant_idx[i]] = -1.0
+        if not sparse:
+            A[r, s0 * n + nonant_idx[i]] = 1.0
+            A[r, s * n + nonant_idx[i]] = -1.0
         bl[r] = bu[r] = 0.0
         r += 1
 
-    qp = boxqp.make_boxqp(c, A, bl, bu, l, u, q=q, dtype=dtype)
+    if sparse:
+        qp = boxqp.BoxQP(
+            c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype), A=A,
+            bl=jnp.asarray(bl, dtype), bu=jnp.asarray(bu, dtype),
+            l=jnp.asarray(l, dtype), u=jnp.asarray(u, dtype))
+    else:
+        qp = boxqp.make_boxqp(c, A, bl, bu, l, u, q=q, dtype=dtype)
     if scale:
         qp, scaling = boxqp.ruiz_scale(qp)
     else:

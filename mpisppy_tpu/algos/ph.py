@@ -257,10 +257,18 @@ class PH:
 
     def Iter0(self) -> float:
         self._ext("pre_iter0")
+        # the batched kernel has no per-scenario solver objects; "solver
+        # creation" is the jitted step build, which happens inside
+        # _iter0_impl — the hook fires at the reference's point in the
+        # sequence (ref:mpisppy/phbase.py:851 after _create_solvers)
+        self._ext("iter0_post_solver_creation")
         self.state, tb, cert = self._iter0_impl()
         self.trivial_bound = float(tb)
         self.trivial_bound_certified = bool(cert)
         self._ext("post_iter0")
+        if self.spcomm is not None:
+            self.spcomm.sync()
+        self._ext("post_iter0_after_sync")
         global_toc(f"{self._label} Iter0: trivial bound = "
                    f"{self.trivial_bound:.6g}",
                    self.options.display_progress)
@@ -272,11 +280,17 @@ class PH:
         for k in range(1, self.options.max_iterations + 1):
             self._iter = k
             self._ext("miditer")
+            # the fused step solves + recomputes xbar/W in one program,
+            # so the solve-loop hooks bracket the whole jitted step
+            # (ref callout points: mpisppy/phbase.py:1016-1045)
+            self._ext("pre_solve_loop")
             self.state = self._iterk_impl()
+            self._ext("post_solve_loop")
             conv = float(self.state.conv)
             self._ext("enditer")
             if self.spcomm is not None:
                 self.spcomm.sync()
+            self._ext("enditer_after_sync")
             global_toc(self._iter_msg(k, conv),
                        self.options.display_progress)
             # The hub object takes precedence over the local convergence

@@ -165,3 +165,41 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
         global_toc(f"gap estimator: G={G:.6g} s={s:.6g}", True)
     return {"G": G, "s": s, "seed": start + len(scenario_names),
             "zn_star": float(np.dot(f_star, p)), "xstar": xstar}
+
+
+def gap_estimators_mstage(xhat_one, module, n_trees: int, cfg,
+                          start_seed: int, branching_factors,
+                          opts: pdhg.PDHGOptions | None = None) -> dict:
+    """Multistage gap estimators over independently sampled scenario
+    TREES (ref:mpisppy/confidence_intervals/multi_seqsampling.py:31-340
+    and ciutils gap_estimators' EF_mstage branch): each i.i.d. sample i
+    is a seeded subtree; z*_i is its free EF optimum, z_xhat_i the EF
+    with the root pinned at xhat (a feasible nonanticipative policy via
+    sample_tree.SampleSubtree).  Both use the SAME seed — common random
+    numbers, the reference's variance-reduction choice.
+
+    Returns {"G", "s", "seed"} with seed advanced by the node-id count
+    of every sampled tree."""
+    from mpisppy_tpu.confidence_intervals.sample_tree import (
+        SampleSubtree, _number_of_nodes,
+    )
+
+    gaps = []
+    zhats = []
+    seed = start_seed
+    for _ in range(n_trees):
+        free = SampleSubtree(module, None, branching_factors, seed, cfg,
+                             opts)
+        zstar = free.run()
+        fixed = SampleSubtree(module, xhat_one, branching_factors, seed,
+                              cfg, opts)
+        zxhat = fixed.run()
+        gaps.append(zxhat - zstar)
+        zhats.append(zxhat)
+        seed += _number_of_nodes(branching_factors)
+    gaps = np.asarray(gaps, np.float64)
+    G = float(np.mean(gaps))
+    s = float(np.std(gaps, ddof=1)) if len(gaps) > 1 else 0.0
+    obj = float(np.mean(zhats))
+    G = correcting_numeric(G, objfct=obj, relative_error=abs(obj) > 1)
+    return {"G": G, "s": s, "seed": seed}

@@ -204,12 +204,114 @@ class SeqSampling:
 
 
 class IndepScens_SeqSampling(SeqSampling):
-    """Multistage variant placeholder keeping the reference's class
-    name (ref:multi_seqsampling.py:31); the two-stage machinery is
-    inherited, the independent-sample multistage path needs
-    sample_tree-driven estimators."""
+    """Multistage sequential sampling over independently sampled
+    scenario TREES (ref:mpisppy/confidence_intervals/
+    multi_seqsampling.py:31-340).  Each i.i.d. sample is one seeded
+    subtree with the configured branching factors; the stopping rules
+    and sample-size recursions are inherited unchanged (they only see
+    (G, s, nk), with nk counting trees).
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "multistage independent-sample sequential sampling is not "
-            "implemented yet; use SeqSampling on two-stage problems")
+    `xhat_generator(mk, start_seed, **kw) -> root xhat`: candidate from
+    mk sampled scenarios; defaults to the root solution of a free
+    sampled-tree EF whose branching factors are scaled so the leaf
+    count is close to mk (ciutils.scalable_branching_factors — the
+    reference's xhat_generator_aircond analog)."""
+
+    def __init__(self, module, xhat_generator, cfg,
+                 stochastic_sampling: bool = False,
+                 stopping_criterion: str = "BM",
+                 solving_type: str = "EF_mstage"):
+        # bypass the parent's EF_2stage guard but reuse all its knobs
+        super().__init__(module, xhat_generator, cfg,
+                         stochastic_sampling=stochastic_sampling,
+                         stopping_criterion=stopping_criterion,
+                         solving_type="EF_2stage")
+        self.solving_type = solving_type
+        bfs = cfg.get("branching_factors")
+        if not bfs:
+            raise RuntimeError("IndepScens_SeqSampling needs "
+                               "cfg['branching_factors']")
+        self.branching_factors = [int(b) for b in bfs]
+        self.numstages = len(self.branching_factors) + 1
+        if self.xhat_generator is None:
+            self.xhat_generator = self._default_xhat_gen
+
+    def _candidate_seed_span(self, mk: int) -> int:
+        """Seed ids a candidate generation consumes — advanced by run()
+        for ANY generator, so a user-supplied xhat_generator can never
+        leave ScenCount behind and have the gap estimator re-sample the
+        very trees the candidate was fit to (which would bias G low and
+        void the coverage guarantee)."""
+        from mpisppy_tpu.confidence_intervals.sample_tree import (
+            _number_of_nodes,
+        )
+        bfs = ciutils.scalable_branching_factors(
+            max(mk, 2), self.branching_factors)
+        return _number_of_nodes(bfs)
+
+    def _default_xhat_gen(self, mk: int, start_seed: int, **_kw):
+        """Root xhat from a free sampled-tree EF with ~mk leaves.
+        Consumes exactly _candidate_seed_span(mk) seed ids; custom
+        generators must do the same (run() advances ScenCount by it)."""
+        from mpisppy_tpu.confidence_intervals.sample_tree import (
+            SampleSubtree,
+        )
+        bfs = ciutils.scalable_branching_factors(
+            max(mk, 2), self.branching_factors)
+        st = SampleSubtree(self.module, None, bfs, start_seed, self.cfg)
+        st.run()
+        sol = st.ef.x                               # (S, n) original
+        nonant_idx = np.asarray(st.ef.ef.nonant_idx)
+        tree = st.ef.ef.tree
+        root_slots = np.nonzero(tree.slot_stage == 1)[0]
+        x_non = sol[:, nonant_idx]
+        xhat = x_non.mean(axis=0)[root_slots]
+        return xhat
+
+    def run(self, maxit: int = 200) -> dict:
+        mult = self.sample_size_ratio
+        bfs = self.branching_factors
+        k = 1
+        lower_bound_k = self.sample_size(k, None, None, None)
+
+        mk = int(math.floor(mult * lower_bound_k))
+        xhat_k = self.xhat_generator(mk, self.ScenCount,
+                                     **self.xhat_gen_kwargs)
+        self.ScenCount += self._candidate_seed_span(mk)
+
+        nk = int(math.ceil(lower_bound_k))
+        est = ciutils.gap_estimators_mstage(
+            xhat_k, self.module, nk, self.cfg, self.ScenCount, bfs)
+        self.ScenCount = est["seed"]
+        Gk, sk = est["G"], est["s"]
+
+        while self.stop_criterion(Gk, sk, nk) and k < maxit:
+            k += 1
+            nk_m1 = nk
+            lower_bound_k = self.sample_size(k, Gk, sk, nk_m1)
+            mk = int(math.floor(mult * lower_bound_k))
+            if k % self.kf_xhat == 0:
+                xhat_k = self.xhat_generator(mk, self.ScenCount,
+                                             **self.xhat_gen_kwargs)
+                self.ScenCount += self._candidate_seed_span(mk)
+            nk = int(math.ceil(lower_bound_k))
+            est = ciutils.gap_estimators_mstage(
+                xhat_k, self.module, nk, self.cfg, self.ScenCount, bfs)
+            self.ScenCount = est["seed"]
+            Gk, sk = est["G"], est["s"]
+            global_toc(f"multistage seq sampling iter {k}: trees={nk} "
+                       f"G={Gk:.5g} s={sk:.5g}", True)
+
+        converged = not self.stop_criterion(Gk, sk, nk)
+        if not converged:
+            global_toc(f"WARNING: sequential sampling hit maxit={maxit} "
+                       "without satisfying the stopping criterion; the "
+                       "returned CI has NO coverage guarantee", True)
+        if self.stopping_criterion == "BM":
+            upper = self.BM_h * sk + self.BM_eps
+        else:
+            t = scipy.stats.t.ppf(self.confidence_level, max(nk - 1, 1))
+            upper = Gk + t * sk / math.sqrt(nk) + 1.0 / math.sqrt(nk)
+        return {"T": k, "Candidate_solution": xhat_k,
+                "CI": [0.0, float(upper)], "G": Gk, "s": sk, "nk": nk,
+                "converged": converged}

@@ -66,7 +66,7 @@ class ScenarioSpec:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["qp", "d_col", "d_row", "d_non", "p", "nonant_idx",
-                 "node_of_slot", "integer_slot", "var_prob"],
+                 "node_of_slot", "integer_slot", "integer_full", "var_prob"],
     meta_fields=["tree", "num_real"],
 )
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +82,8 @@ class ScenarioBatch:
     nonant_idx:   (N,) int32 nonant column indices (shared layout).
     node_of_slot: (S, N) int32 owning tree-node id per scenario slot.
     integer_slot: (N,) bool integrality of each nonant slot.
+    integer_full: (n,) bool integrality of EVERY column (the exact-MIP
+                  path, ops/bnb.py, branches over all of these).
     tree:         static ScenarioTree metadata.
     num_real:     scenarios before mesh padding.
     """
@@ -94,6 +96,7 @@ class ScenarioBatch:
     nonant_idx: Array
     node_of_slot: Array
     integer_slot: Array
+    integer_full: Array
     tree: ScenarioTree
     num_real: int
     # (S, N) per-(scenario, slot) nonant weights, or None for ordinary
@@ -314,6 +317,7 @@ def from_specs(specs: list[ScenarioSpec],
         nonant_idx=jnp.asarray(nonant_idx),
         node_of_slot=jnp.asarray(tree.node_of_slot()),
         integer_slot=jnp.asarray(integer[nonant_idx]),
+        integer_full=jnp.asarray(integer),
         tree=tree,
         num_real=len(specs),
     )

@@ -149,8 +149,9 @@ class PHHub(Hub):
             sp.update(payload)
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
+        import time as _time
         self.trace.append({
-            "iter": self._iter, **extra,
+            "iter": self._iter, **extra, "t": _time.perf_counter(),
             "outer": self.BestOuterBound, "inner": self.BestInnerBound,
             "abs_gap": abs_gap, "rel_gap": rel_gap,
             "ob_char": self.latest_ob_char, "ib_char": self.latest_ib_char,
@@ -169,9 +170,13 @@ class PHHub(Hub):
         # (ref:hub.py:544) — but only when its dual-residual certificate
         # held: a truncated iter0 primal value can exceed the optimum,
         # and an invalid outer bound here would fire the "certified" gap
-        # termination wrongly.
-        if (self.opt.trivial_bound is not None and self._iter <= 1
+        # termination wrongly.  A once-flag, not an iteration-count gate:
+        # the driver also syncs after Iter0 (ref:phbase.py:905-910), so
+        # by the first is_converged call _iter is already 2.
+        if (self.opt.trivial_bound is not None
+                and not getattr(self, "_trivial_bound_folded", False)
                 and getattr(self.opt, "trivial_bound_certified", False)):
+            self._trivial_bound_folded = True
             self.OuterBoundUpdate(self.opt.trivial_bound, "T")
         return self.determine_termination()
 

@@ -218,18 +218,32 @@ class FWPHOuterBound(OuterBoundSpoke):
 # Inner bounds (incumbent finders)
 # ---------------------------------------------------------------------------
 class XhatXbarInnerBound(InnerBoundSpoke):
-    """x̂ = rounded x̄ (ref:cylinders/xhatxbar_bounder.py:37)."""
+    """x̂ = rounded x̄ (ref:cylinders/xhatxbar_bounder.py:37).
+
+    Carries warm PDHG state across syncs: consecutive x̄ candidates
+    differ little, so each sync's recourse solve starts from the last
+    one's iterates (round-2 review weakness #7)."""
 
     converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
                              ConvergerSpokeType.NONANT_GETTER)
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self._solver = None
 
     def update(self, hub_payload):
         xbar_nodes = hub_payload["xbar_nodes"]
         # cache the ROUNDED candidate: the bound is evaluated at it, so
         # the incumbent written out must be the same point
         cand = xhat_mod.round_integers(self.batch, xbar_nodes)
-        self._pending = (xhat_mod.evaluate(self.batch, cand,
-                                           self.pdhg_opts), cand)
+        if self._solver is None:
+            import dataclasses as _dc
+            qp = self.batch.with_fixed_nonants(cand)
+            self._solver = pdhg.init_state(
+                qp, _dc.replace(self.pdhg_opts, detect_infeas=True))
+        res, self._solver = xhat_mod.evaluate_warm(
+            self.batch, cand, self._solver, self.pdhg_opts)
+        self._pending = (res, cand)
 
 
 class XhatShuffleInnerBound(InnerBoundSpoke):
@@ -277,6 +291,50 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
             j = int(np.argmin(np.where(feas, vals, np.inf)))
             self._offer(float(vals[j]), np.asarray(cands)[j])
         return self.bound
+
+
+class XhatLooperInnerBound(XhatShuffleInnerBound):
+    """Fixed-order looper: tries the first `scen_limit` scenarios per
+    sync in SCENARIO ORDER, no shuffle
+    (ref:mpisppy/cylinders/xhatlooper_bounder.py:23 — the pre-shuffle
+    looper; same batched (k,S) evaluation here, identity permutation)."""
+
+    def __init__(self, opt, options=None):
+        options = dict(options or {})
+        options.setdefault("k", int(options.pop("scen_limit", 3)))
+        super().__init__(opt, options)
+        self._order = np.arange(self.batch.num_real)  # identity, no rng
+
+
+class XhatSpecificInnerBound(InnerBoundSpoke):
+    """Evaluates USER-NAMED candidate scenarios' first stages
+    (ref:mpisppy/cylinders/xhatspecific_bounder.py:25; the reference
+    takes a {node: scenario_name} dict via 'xhat_specific_dict').
+    options: 'scenario_names' (list of names) or 'scenario_ids'."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        ids = self.options.get("scenario_ids")
+        if ids is None:
+            names = self.options.get("scenario_names")
+            if names is None:
+                raise ValueError("XhatSpecificInnerBound needs "
+                                 "'scenario_ids' or 'scenario_names'")
+            lookup = {nm: i for i, nm in enumerate(
+                getattr(opt, "scenario_names", []))}
+            ids = [lookup[nm] for nm in names]
+        self._ids = jnp.asarray(list(ids))
+
+    def update(self, hub_payload):
+        x_non = hub_payload["nonants"]
+        self._pending = xhat_mod.xhat_shuffle(
+            self.batch, x_non, self._ids, int(self._ids.shape[0]),
+            self.pdhg_opts)
+
+    harvest = XhatShuffleInnerBound.harvest
 
 
 class XhatLShapedInnerBound(XhatXbarInnerBound):

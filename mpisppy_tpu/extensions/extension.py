@@ -5,9 +5,14 @@
 # mutate the driver (`self.opt`): its options, its device-resident
 # PHState (via dataclasses.replace on host), or its batch (e.g. the
 # Fixer collapses nonant boxes).  All 14 reference callout points exist;
-# PH currently drives pre_iter0/post_iter0/miditer/enditer/
-# post_everything, and the cylinder layer drives setup_hub/
-# sync_with_spokes.
+# PH drives pre_iter0/iter0_post_solver_creation/post_iter0/
+# post_iter0_after_sync/miditer/pre_solve_loop/post_solve_loop/enditer/
+# enditer_after_sync/post_everything at the reference's callout points
+# (ref:mpisppy/phbase.py:829-1061), and the cylinder layer drives
+# setup_hub/sync_with_spokes.  pre_solve/post_solve (per-SUBPROBLEM
+# hooks) have no per-scenario callout in the batched design — the whole
+# solve loop is one program — so they fire only via MultiExtension
+# users calling them explicitly.
 ###############################################################################
 from __future__ import annotations
 

@@ -1,0 +1,8 @@
+# W-oscillation diagnostics as a PH extension — import-path parity with
+# ref:mpisppy/extensions/wtracker_extension.py:15 (the implementation
+# lives with its WTracker in utils/wtracker.py).
+from mpisppy_tpu.utils.wtracker import WTracker, WTrackerExtension
+
+__all__ = ["WTracker", "WTrackerExtension"]
+
+Wtracker_extension = WTrackerExtension  # reference class-name spelling

@@ -57,6 +57,7 @@ def _parse_args(module, args=None):
     cfg.cross_scenario_cuts_args()
     cfg.lshaped_args()
     cfg.converger_args()
+    cfg.presolve_args()
     cfg.wxbar_read_write_args()
     cfg.proper_bundle_config()
     cfg.multistage()
@@ -87,6 +88,20 @@ def _model_plumbing(cfg, module):
     return names, kwargs, tree
 
 
+def _presolve_maybe(cfg, batch):
+    if not cfg.get("presolve"):
+        return batch
+    from mpisppy_tpu.ops.fbbt import presolve_batch
+    try:
+        batch, info = presolve_batch(
+            batch, n_sweeps=cfg.get("presolve_sweeps", 3))
+    except ValueError as e:
+        raise SystemExit(f"presolve: {e}")
+    global_toc(f"presolve: tightened {info['tightened_bounds']} bounds",
+               cfg.get("display_progress", False))
+    return batch
+
+
 def _build_batch(cfg, module):
     names, kwargs, tree = _model_plumbing(cfg, module)
     if cfg.get("scenarios_per_bundle"):
@@ -107,9 +122,11 @@ def _build_batch(cfg, module):
         kwargs = pb.kw_creator(cfg)
         names = pb.bundle_names_creator(num_buns, cfg=cfg)
         specs = [pb.scenario_creator(nm, **kwargs) for nm in names]
-        return batch_mod.from_specs(specs), names, specs
+        return _presolve_maybe(cfg, batch_mod.from_specs(specs)), \
+            names, specs
     specs = [module.scenario_creator(nm, **kwargs) for nm in names]
-    return batch_mod.from_specs(specs, tree=tree), names, specs
+    batch = _presolve_maybe(cfg, batch_mod.from_specs(specs, tree=tree))
+    return batch, names, specs
 
 
 def _do_EF(cfg, module):

@@ -171,7 +171,11 @@ def _build_spec(inst: dict, client_present: np.ndarray,
         A[j, n + m * n + j] = -1.0                   # -d_j
 
         l = np.zeros(ncols)  # noqa: E741
-        u = np.concatenate([np.ones(n + m * n), np.full(n, np.inf)])
+        # d_j only absorbs D·y_j - Cap x_j <= sum_i D_ij, so the natural
+        # finite bound is the column demand sum; finite boxes everywhere
+        # make every ops.boxqp.certified_dual_bound finite (the exact-MIP
+        # branch-and-bound prunes on it)
+        u = np.concatenate([np.ones(n + m * n), D.sum(axis=0)])
 
         # client rows (one per client i): sum_j y_ij == h_i
         for i in range(m):

@@ -7,14 +7,28 @@
 # is a native generator with the same decision structure:
 #
 #   first stage  (nonant): commitment u_{g,t} in {0,1}, all hours
-#   second stage:          dispatch  p_{g,t} >= 0, load shed s_t >= 0
+#   first stage  (implied): startup v_{g,t}, shutdown w_{g,t} in [0,1]
+#                 (continuous: integral whenever u is — Rajan-Takriti)
+#   second stage:          dispatch p_{g,t} >= 0, load shed s_t >= 0,
+#                          reserve shortfall r_t >= 0
 #   gen limits:  Pmin_g u_{g,t} <= p_{g,t} <= Pmax_g u_{g,t}
 #   balance:     sum_g p_{g,t} + s_t = d_t^scen
 #   ramping:     |p_{g,t} - p_{g,t-1}| <= R_g
-#   objective:   sum fixed_g u + c_g p + VOLL * s
+#   state:       u_{g,t} - u_{g,t-1} - v_{g,t} + w_{g,t} = 0  (u_{g,-1}=0)
+#   min-up:      sum_{tau in (t-UT_g, t]} v_{g,tau} <= u_{g,t}
+#   min-down:    sum_{tau in (t-DT_g, t]} w_{g,tau} <= 1 - u_{g,t}
+#   reserve:     sum_g Pmax_g u_{g,t} + r_t >= (1+rho_r) d_t^scen
+#   objective:   sum cfix_g u + cstart_g v + cvar_g p
+#                + VOLL * s + CRSV * r
+#
+# (min-up/down are the turn-on/turn-off inequalities of Rajan &
+# Takriti's strong formulation — the same constraint family egret's UC
+# uses, ref:examples/uc/uc_funcs.py params min_up_time/min_down_time;
+# startup costs ref egret startup_cost; reserves ref uc_funcs
+# reserve_factor.)
 #
 #   randomness: hourly demand d^scen = profile * seeded per-scenario
-#   multiplicative AR(1) noise — only the balance RHS varies, so the
+#   multiplicative AR(1) noise — only balance/reserve RHS vary, so the
 #   sparse constraint matrix is SHARED across all scenarios (one ELL
 #   block in HBM regardless of scenario count).
 #
@@ -29,7 +43,8 @@ import scipy.sparse as sps
 from mpisppy_tpu.core.batch import ScenarioSpec
 from mpisppy_tpu.utils.sputils import extract_num
 
-_VOLL = 5000.0
+_VOLL = 5000.0    # $/MWh unserved energy
+_CRSV = 1100.0    # $/MWh reserve shortfall (well below VOLL)
 
 
 def synthetic_instance(n_gens: int = 10, n_hours: int = 24,
@@ -45,6 +60,12 @@ def synthetic_instance(n_gens: int = 10, n_hours: int = 24,
         "ramp": 0.35 * pmax,
         "cvar": rng.uniform(10.0, 40.0, n_gens),     # $/MWh
         "cfix": rng.uniform(300.0, 1200.0, n_gens),  # $/h committed
+        # startup costs scale with unit size (cold-start heuristic)
+        "cstart": rng.uniform(2.0, 8.0, n_gens) * pmax,
+        # bigger units cycle slower
+        "min_up": np.clip((pmax / 80.0).astype(int) + 1, 1, 8),
+        "min_down": np.clip((pmax / 100.0).astype(int) + 1, 1, 6),
+        "reserve_frac": 0.1,
         # diurnal profile peaking at ~70% of fleet capacity
         "profile": 0.5 * pmax.sum()
         * (1.0 + 0.35 * np.sin(2.0 * np.pi
@@ -64,15 +85,24 @@ def scenario_demand(inst: dict, scennum: int) -> np.ndarray:
 
 
 def _shared_structure(inst: dict):
-    """(A, c, l, u, integer, nonant_idx) — scenario-independent; cached
-    on the instance dict so the batch compiler's shared-object fast path
-    sees one sparse A for the whole batch."""
+    """(A, c, l, u, integer, nonant_idx, row markers) —
+    scenario-independent; cached on the instance dict so the batch
+    compiler's shared-object fast path sees one sparse A for the whole
+    batch.  Column layout (g-major time blocks):
+      [0:nU)          u_{g,t} commitment        {0,1}   <- nonants
+      [nU:2nU)        p_{g,t} dispatch          [0,Pmax]
+      [2nU:2nU+T)     s_t load shed             [0,inf)
+      [2nU+T:3nU+T)   v_{g,t} startup           [0,1]
+      [3nU+T:4nU+T)   w_{g,t} shutdown          [0,1]
+      [4nU+T:4nU+2T)  r_t reserve shortfall     [0,inf)
+    """
     if "_spec_cache" in inst:
         return inst["_spec_cache"]
     G, T = inst["n_gens"], inst["n_hours"]
     nU = G * T
-    U0, P0, S0 = 0, nU, 2 * nU      # u (g-major: g*T+t), p, shed
-    n = 2 * nU + T
+    U0, P0, S0 = 0, nU, 2 * nU
+    V0, W0, R0 = 2 * nU + T, 3 * nU + T, 4 * nU + T
+    n = 4 * nU + 2 * T
 
     rows, cols, vals = [], [], []
     r = 0
@@ -111,6 +141,56 @@ def _shared_structure(inst: dict):
             cols += [P0 + g * T + t - 1, P0 + g * T + t]
             vals += [1.0, -1.0]
             r += 1
+    # commitment state logic: u_t - u_{t-1} - v_t + w_t = 0 (u_{-1} = 0)
+    state0 = r
+    for g in range(G):
+        for t in range(T):
+            rows.append(r)
+            cols.append(U0 + g * T + t)
+            vals.append(1.0)
+            if t > 0:
+                rows.append(r)
+                cols.append(U0 + g * T + t - 1)
+                vals.append(-1.0)
+            rows += [r, r]
+            cols += [V0 + g * T + t, W0 + g * T + t]
+            vals += [-1.0, 1.0]
+            r += 1
+    # min-up:  sum_{tau=max(0,t-UT+1)..t} v_tau - u_t <= 0
+    for g in range(G):
+        UT = int(inst["min_up"][g])
+        for t in range(T):
+            for tau in range(max(0, t - UT + 1), t + 1):
+                rows.append(r)
+                cols.append(V0 + g * T + tau)
+                vals.append(1.0)
+            rows.append(r)
+            cols.append(U0 + g * T + t)
+            vals.append(-1.0)
+            r += 1
+    # min-down: sum_{tau=max(0,t-DT+1)..t} w_tau + u_t <= 1
+    for g in range(G):
+        DT = int(inst["min_down"][g])
+        for t in range(T):
+            for tau in range(max(0, t - DT + 1), t + 1):
+                rows.append(r)
+                cols.append(W0 + g * T + tau)
+                vals.append(1.0)
+            rows.append(r)
+            cols.append(U0 + g * T + t)
+            vals.append(1.0)
+            r += 1
+    # spinning reserve: -sum_g Pmax_g u_{g,t} - r_t <= -(1+rho) d_t
+    rsv0 = r
+    for t in range(T):
+        for g in range(G):
+            rows.append(r)
+            cols.append(U0 + g * T + t)
+            vals.append(-inst["pmax"][g])
+        rows.append(r)
+        cols.append(R0 + t)
+        vals.append(-1.0)
+        r += 1
     m = r
     A = sps.csr_matrix((vals, (rows, cols)), shape=(m, n))
 
@@ -118,18 +198,21 @@ def _shared_structure(inst: dict):
     for g in range(G):
         c[U0 + g * T:U0 + (g + 1) * T] = inst["cfix"][g]
         c[P0 + g * T:P0 + (g + 1) * T] = inst["cvar"][g]
+        c[V0 + g * T:V0 + (g + 1) * T] = inst["cstart"][g]
     c[S0:S0 + T] = _VOLL
+    c[R0:R0 + T] = _CRSV
 
     l = np.zeros(n)  # noqa: E741
     u = np.ones(n)
     for g in range(G):
         u[P0 + g * T:P0 + (g + 1) * T] = inst["pmax"][g]
-    u[S0:S0 + T] = np.inf
+    u[S0:S0 + T] = inst["profile"].max() * 2.0   # shed <= any demand
+    u[R0:R0 + T] = inst["pmax"].sum()            # shortfall <= requirement
 
     integer = np.zeros(n, bool)
     integer[U0:U0 + nU] = True
     nonant_idx = np.arange(nU, dtype=np.int32)
-    inst["_spec_cache"] = (A, c, l, u, integer, nonant_idx, bal0, m)
+    inst["_spec_cache"] = (A, c, l, u, integer, nonant_idx, bal0, rsv0, m)
     return inst["_spec_cache"]
 
 
@@ -140,7 +223,8 @@ def scenario_creator(scenario_name: str, instance: dict | None = None,
     """Zero-based Scenario<k> names (ref:examples/uc convention)."""
     if instance is None:
         instance = synthetic_instance(n_gens, n_hours, seed)
-    A, c, l, u, integer, nonant_idx, bal0, m = _shared_structure(instance)
+    A, c, l, u, integer, nonant_idx, bal0, rsv0, m = \
+        _shared_structure(instance)
     T = instance["n_hours"]
     k = extract_num(scenario_name)
     d = scenario_demand(instance, k)
@@ -155,6 +239,14 @@ def scenario_creator(scenario_name: str, instance: dict | None = None,
     for g in range(G):
         bu[rr:rr + 2 * (T - 1)] = instance["ramp"][g]
         rr += 2 * (T - 1)
+    # state rows are equalities (== 0); they follow the ramp block
+    nU = G * T
+    bl[rr:rr + nU] = 0.0
+    # min-up rows: <= 0 (already); min-down rows: <= 1
+    md0 = rr + nU + nU
+    bu[md0:md0 + nU] = 1.0
+    # reserve rows: -cap - r <= -(1 + rho) d
+    bu[rsv0:rsv0 + T] = -(1.0 + instance["reserve_frac"]) * d
 
     integer_eff = integer if not lp_relax else np.zeros_like(integer)
     return ScenarioSpec(

@@ -1,0 +1,667 @@
+###############################################################################
+# Batched branch-and-bound on the PDHG LP/QP kernel: the exact-MIP path.
+#
+# The reference gets exact integer solves from Gurobi/CPLEX per scenario
+# subproblem (ref:mpisppy/spopt.py:99-247,884) — sslp/sizes/netdes/uc are
+# MIPs, and PH/Lagrangian/xhat all lean on those exact solves.  A TPU
+# framework has no MIP solver to call, so this module IS one, built
+# TPU-first:
+#
+#   * The batch axis is scenarios: every round pops the best-first open
+#     node of EVERY scenario's tree and solves all of those LP
+#     relaxations as ONE batched PDHG call.  S scenario MIPs advance in
+#     lockstep as a single tensor program — the analog of the
+#     reference's per-rank sequential Gurobi loop is a (S,)-shaped
+#     best-first step.
+#   * All control flow is masked tensor math over a fixed-size node pool
+#     (static shapes; no per-scenario Python).  The host only runs the
+#     outer round loop and checks the (S,) done mask.
+#   * Pruning uses ops.boxqp.certified_dual_bound — valid for ANY
+#     iterates by weak duality — so inexact first-order LP solves can
+#     never fathom the true optimum.  The reported outer bound folds in
+#     every fathomed/dropped subtree's bound, making the final
+#     (inner, outer) bracket a certificate, not a heuristic.
+#   * Incumbents come from an integer-feasible leaf (all-integral LP
+#     vertex, or a dive node with every integer column fixed), accepted
+#     only when the LP's primal residual clears `feas_tol` — the same
+#     standard any LP-based MIP solver certifies feasibility to.
+#
+# The dive heuristic (`dive`) is the cheaper fix-and-round path: rounds
+# of "fix all near-integral columns (+ the most integral fractional
+# one), re-solve" until everything integer is pinned — one incumbent in
+# O(tens) of batched LP solves.  solve_mip() runs it first so
+# branch-and-bound starts with a finite incumbent.
+#
+# Node state per (scenario, pool slot): ORIGINAL-space lower/upper
+# bounds of the integer columns only (the continuous box never changes),
+# plus the subtree's certified bound.  Ruiz column scalings map the
+# integral branching values into the scaled space the kernel solves in.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.ops import boxqp, pdhg
+from mpisppy_tpu.ops.boxqp import BoxQP
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class BnBOptions:
+    """Static branch-and-bound options (hashable: jit-static).
+
+    The node-LP defaults are LOOSER than the standalone kernel's
+    (tol 1e-5, 8k iters): certified_dual_bound stays valid at any
+    tolerance, so inexact node solves only weaken pruning, never
+    correctness — and warm-started children rarely need more.  feas_tol
+    and int_tol sit an order above the LP tol so incumbents found at
+    that tolerance are actually accepted."""
+
+    gap_tol: float = 1e-3       # terminate at (inner-outer) <= gap_tol*scale
+    int_tol: float = 1e-4       # max |x - round(x)| to accept integrality
+    feas_tol: float = 1e-4      # relative primal residual for incumbents
+    pool_size: int = 64         # open-node slots per scenario
+    max_rounds: int = 400       # outer (host) round budget
+    dive_rounds: int = 16       # confident-wave rounds in the dive pass
+    dive_tol: float = 0.1       # "near-integral" fixing threshold
+    dive_tail: int = 96         # one-at-a-time rounds for ambiguous cols
+    # nearly-integral branched nodes (maxfrac <= pin_frac_tol) ALSO
+    # enqueue a "pin" probe with all integer columns fixed at their
+    # (half-up) rounding: its solve yields an EXACT incumbent.  Keep the
+    # gate tight: probing every node (1.0) burns ~half the plunge
+    # rounds on infeasible roundings of mid-face iterates.
+    pin_frac_tol: float = 0.05
+    # plunge tie tolerance (relative): nodes within this of the best
+    # bound count as tied, and the DEEPEST tied node is popped — turning
+    # degenerate tied regions into a dive (see bnb_round selection).
+    # Only the SEARCH ORDER is affected (fathoming uses exact bounds),
+    # so this is safe to loosen on heavily degenerate problems.
+    plunge_tol: float = 1e-3
+    # objective-feasibility-pump rounds run after the dive for
+    # incumbents (0 disables); the pump handles the capacity-coupled
+    # degenerate structures where rounding-based dives stall
+    pump_rounds: int = 25
+    # deterministic relative objective jitter for the NODE SOLVES ONLY:
+    # breaks degenerate ties so the kernel's face-point iterates move
+    # toward a unique vertex.  Bounds and objectives are always
+    # evaluated against the TRUE costs, so correctness is unaffected.
+    # Default OFF: at jitter below the LP tolerance the solver cannot
+    # resolve the perturbation anyway (measured on sslp), and larger
+    # jitters distort the search.
+    jitter: float = 0.0
+    lp: pdhg.PDHGOptions = pdhg.PDHGOptions(tol=1e-5, max_iters=8_000)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["pool_lo", "pool_hi", "pool_bound", "pool_active",
+                 "pool_depth",
+                 "incumbent", "x_inc", "fathom_floor", "lost_bound",
+                 "x_warm", "y_warm", "omega_warm", "Lnorm",
+                 "outer", "done", "nodes_solved"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class BnBState:
+    pool_lo: Array       # (S, P, nI) original-space int lower bounds
+    pool_hi: Array       # (S, P, nI)
+    pool_bound: Array    # (S, P) certified subtree lower bound (+inf empty)
+    pool_active: Array   # (S, P) bool
+    pool_depth: Array    # (S, P) int32 tree depth (plunge tie-break)
+    incumbent: Array     # (S,) best integer-feasible objective (+inf none)
+    x_inc: Array         # (S, n) incumbent solution, ORIGINAL space
+    fathom_floor: Array  # (S,) min bound over fathomed subtrees (+inf)
+    lost_bound: Array    # (S,) min bound over pool-overflow drops (+inf)
+    x_warm: Array        # (S, n) scaled-space warm start
+    y_warm: Array        # (S, m)
+    omega_warm: Array    # (S,) adapted PDHG primal weight, carried over
+    Lnorm: Array         # (S,) ||A||_2 (bounds never change A: computed once)
+    outer: Array         # (S,) certified global lower bound
+    done: Array          # (S,) bool
+    nodes_solved: Array  # (S,) int32
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["x", "inner", "outer", "gap", "feasible", "nodes_solved"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class BnBResult:
+    x: Array            # (S, n) best integer solution, ORIGINAL space
+    inner: Array        # (S,) its objective (+inf if none found)
+    outer: Array        # (S,) certified lower bound
+    gap: Array          # (S,) relative certified gap
+    feasible: Array     # (S,) bool — an integer-feasible point was found
+    nodes_solved: Array  # (S,) int32
+
+
+def _node_qp(qp: BoxQP, d_col: Array, int_cols: Array,
+             lo: Array, hi: Array) -> BoxQP:
+    """Base qp with the integer columns' box replaced by the node's
+    ORIGINAL-space [lo, hi] (mapped through the column scaling)."""
+    S, n = qp.c.shape
+    l_full = jnp.broadcast_to(qp.l, (S, n))
+    u_full = jnp.broadcast_to(qp.u, (S, n))
+    d_int = jnp.broadcast_to(d_col, (S, n))[:, int_cols]
+    return dataclasses.replace(
+        qp,
+        l=l_full.at[:, int_cols].set(lo / d_int),
+        u=u_full.at[:, int_cols].set(hi / d_int),
+    )
+
+
+def _solve_node(qp_node: BoxQP, x_warm: Array, y_warm: Array,
+                lp_opts: pdhg.PDHGOptions,
+                omega: Array | None = None, Lnorm: Array | None = None,
+                jitter: float = 0.0):
+    """Batched LP solve of the current nodes, warm-started (iterates AND
+    step-size machinery: omega adaptation + the one-time ||A|| estimate
+    carry across nodes, since branching only moves bounds, never A).
+
+    `jitter` perturbs the SOLVE's costs by a fixed pseudorandom relative
+    amount to break degeneracy (vertex-steering, see BnBOptions.jitter);
+    the returned objective, certified bound, and residuals are all
+    evaluated against the TRUE qp_node, so every number downstream
+    remains exact.
+    Returns (solver_state, objective, certified_lb, primal_residual)."""
+    lp = dataclasses.replace(lp_opts, detect_infeas=True)
+    if jitter > 0.0:
+        u = jax.random.uniform(jax.random.PRNGKey(17),
+                               (qp_node.c.shape[-1],), qp_node.c.dtype)
+        cscale = jnp.maximum(jnp.mean(jnp.abs(qp_node.c), axis=-1,
+                                      keepdims=True), 1.0)
+        qp_solve = dataclasses.replace(
+            qp_node, c=qp_node.c + jitter * cscale * (u - 0.5))
+    else:
+        qp_solve = qp_node
+    x0 = jnp.clip(x_warm, qp_node.l, qp_node.u)
+    if omega is None or Lnorm is None:
+        st0 = pdhg.init_state(qp_solve, lp, x0=x0, y0=y_warm)
+    else:
+        bs = qp_node.c.shape[:-1]
+        dt = qp_node.c.dtype
+        st0 = pdhg.PDHGState(
+            x=x0, y=y_warm,
+            x_sum=jnp.zeros_like(x0), y_sum=jnp.zeros_like(y_warm),
+            x_anchor=x0, y_anchor=y_warm,
+            omega=omega, Lnorm=Lnorm,
+            k=jnp.zeros((), jnp.int32), nwin=jnp.zeros(bs, jnp.int32),
+            restart_score=jnp.full(bs, jnp.inf, dt),
+            score=jnp.full(bs, jnp.inf, dt),
+            done=jnp.zeros(bs, bool), status=jnp.zeros(bs, jnp.int32))
+    sol = pdhg.solve(qp_solve, lp, st0)
+    obj = jnp.sum(qp_node.c * sol.x + 0.5 * qp_node.q * sol.x * sol.x,
+                  axis=-1)
+    lb = boxqp.certified_dual_bound(qp_node, sol.x, sol.y)
+    rp, _, _ = boxqp.kkt_residuals(qp_node, sol.x, sol.y)
+    return sol, obj, lb, rp
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def bnb_round(qp: BoxQP, d_col: Array, int_cols: Array, st: BnBState,
+              opts: BnBOptions) -> BnBState:
+    """One best-first round: pop each scenario's lowest-bound open node,
+    solve the batch of LP relaxations, then fathom/branch per scenario."""
+    S, P, nI = st.pool_lo.shape
+    dt = qp.c.dtype
+    inf = jnp.asarray(jnp.inf, dt)
+
+    # PLUNGING selection: among active nodes whose bound ties the best
+    # (within a relative epsilon), pop the DEEPEST.  Pure best-first
+    # wanders across the many equal-bound siblings a degenerate LP
+    # produces and can burn its whole round budget without ever
+    # reaching an integral leaf (observed on sslp recourse MIPs); the
+    # depth bias turns tied regions into a dive while keeping exact
+    # best-first behavior across genuinely different bounds.
+    key = jnp.where(st.pool_active, st.pool_bound, inf)
+    bmin = jnp.min(key, axis=1, keepdims=True)
+    tie_eps = opts.plunge_tol * jnp.maximum(1.0, jnp.abs(bmin))
+    thresh = jnp.where(jnp.isfinite(bmin), bmin + tie_eps, inf)
+    tied = st.pool_active & (key <= thresh)
+    sel = jnp.argmax(jnp.where(tied, st.pool_depth, -1), axis=1)  # (S,)
+    has = jnp.any(st.pool_active, axis=1) & ~st.done    # (S,)
+    sel_oh = jax.nn.one_hot(sel, P, dtype=bool)         # (S, P)
+
+    def take2(a):  # (S, P, nI) -> (S, nI)
+        return jnp.take_along_axis(a, sel[:, None, None], axis=1)[:, 0]
+
+    lo = take2(st.pool_lo)
+    hi = take2(st.pool_hi)
+    parent = jnp.take_along_axis(st.pool_bound, sel[:, None], axis=1)[:, 0]
+
+    qpn = _node_qp(qp, d_col, int_cols, lo, hi)
+    sol, obj, lb, rp = _solve_node(qpn, st.x_warm, st.y_warm, opts.lp,
+                                   st.omega_warm, st.Lnorm,
+                                   jitter=opts.jitter)
+    box_ok = jnp.all(lo <= hi, axis=1)
+    infeas = (sol.status == pdhg.INFEASIBLE) | ~box_ok
+    lb = jnp.where(infeas, inf, jnp.maximum(lb, parent))
+
+    x_orig = sol.x * jnp.broadcast_to(d_col, sol.x.shape)
+    xi = x_orig[:, int_cols]
+    frac = jnp.abs(xi - jnp.round(xi))
+    maxfrac = jnp.max(frac, axis=1)
+    feas = rp <= opts.feas_tol
+    is_int = has & (maxfrac <= opts.int_tol) & feas & ~infeas
+
+    # -- incumbent ---------------------------------------------------------
+    better = is_int & (obj < st.incumbent)
+    incumbent = jnp.where(better, obj, st.incumbent)
+    x_inc = jnp.where(better[:, None], x_orig, st.x_inc)
+
+    # -- fathoming ---------------------------------------------------------
+    scale = jnp.maximum(1.0, jnp.abs(incumbent))
+    thresh = jnp.where(jnp.isfinite(incumbent),
+                       incumbent - opts.gap_tol * scale, inf)
+    prune = has & ~is_int & ~infeas & (lb >= thresh)
+    fathomed = has & (is_int | prune)           # subtree closed with bound lb
+    fathom_floor = jnp.where(fathomed, jnp.minimum(st.fathom_floor, lb),
+                             st.fathom_floor)
+    branch = has & ~is_int & ~prune & ~infeas
+
+    # -- branch: child_down replaces the popped slot, child_up goes to a
+    #    free slot (or evicts the worst open node, logging its bound) ------
+    jstar = jnp.argmax(frac, axis=1)                    # (S,)
+    j_oh = jax.nn.one_hot(jstar, nI, dtype=bool)
+    v = jnp.take_along_axis(xi, jstar[:, None], axis=1)[:, 0]
+    fl = jnp.floor(v)
+    hi_down = jnp.where(j_oh, fl[:, None], hi)
+    lo_up = jnp.where(j_oh, fl[:, None] + 1.0, lo)
+    # plunge ordering: the popped slot inherits the ROUNDED side (the
+    # depth tie-break prefers lower slot indices, so the sel slot leads
+    # the dive) — branching toward the iterate's rounding is the dive
+    # direction that tends to stay feasible
+    round_up = (v - fl) >= 0.5
+    sel_lo = jnp.where(round_up[:, None], lo_up, lo)
+    sel_hi = jnp.where(round_up[:, None], hi, hi_down)
+    oth_lo = jnp.where(round_up[:, None], lo, lo_up)
+    oth_hi = jnp.where(round_up[:, None], hi_down, hi)
+
+    # write child_down into slot `sel` (or deactivate it when fathomed)
+    depth = jnp.take_along_axis(st.pool_depth, sel[:, None], axis=1)[:, 0]
+    child_depth = depth + 1
+    m_sel = sel_oh & branch[:, None]
+    pool_hi = jnp.where(m_sel[:, :, None], sel_hi[:, None, :], st.pool_hi)
+    pool_lo = jnp.where(m_sel[:, :, None], sel_lo[:, None, :], st.pool_lo)
+    pool_bound = jnp.where(m_sel, lb[:, None], st.pool_bound)
+    pool_depth = jnp.where(m_sel, child_depth[:, None], st.pool_depth)
+    closed = sel_oh & (has & ~branch)[:, None]
+    pool_active = st.pool_active & ~closed
+
+    # free slot for child_up: first inactive, else evict worst open node
+    any_free = jnp.any(~pool_active, axis=1)
+    first_free = jnp.argmin(pool_active, axis=1)        # first False
+    worst = jnp.argmax(jnp.where(pool_active, pool_bound, -inf), axis=1)
+    slot_up = jnp.where(any_free, first_free, worst)
+    up_oh = jax.nn.one_hot(slot_up, P, dtype=bool) & branch[:, None]
+    evict = branch & ~any_free
+    evicted_bound = jnp.take_along_axis(pool_bound, worst[:, None],
+                                        axis=1)[:, 0]
+    lost_bound = jnp.where(evict, jnp.minimum(st.lost_bound, evicted_bound),
+                           st.lost_bound)
+    pool_lo = jnp.where(up_oh[:, :, None], oth_lo[:, None, :], pool_lo)
+    pool_hi = jnp.where(up_oh[:, :, None], oth_hi[:, None, :], pool_hi)
+    pool_bound = jnp.where(up_oh, lb[:, None], pool_bound)
+    pool_depth = jnp.where(up_oh, child_depth[:, None], pool_depth)
+    pool_active = pool_active | up_oh
+
+    # -- pin probe: near-integral branched nodes also enqueue the fully
+    #    rounded assignment (exact incumbent when popped); only into a
+    #    genuinely free slot — probes never evict real nodes ---------------
+    want_pin = branch & (maxfrac <= opts.pin_frac_tol)
+    free_pin = jnp.any(~pool_active, axis=1)
+    slot_pin = jnp.argmin(pool_active, axis=1)
+    pin_oh = jax.nn.one_hot(slot_pin, P, dtype=bool) \
+        & (want_pin & free_pin)[:, None]
+    r_pin = jnp.clip(jnp.floor(xi + 0.5), lo, hi)
+    pool_lo = jnp.where(pin_oh[:, :, None], r_pin[:, None, :], pool_lo)
+    pool_hi = jnp.where(pin_oh[:, :, None], r_pin[:, None, :], pool_hi)
+    pool_bound = jnp.where(pin_oh, lb[:, None], pool_bound)
+    # probes outrank both children in the plunge order
+    pool_depth = jnp.where(pin_oh, child_depth[:, None] + 1, pool_depth)
+    pool_active = pool_active | pin_oh
+
+    # -- certified global outer bound + termination ------------------------
+    open_min = jnp.min(jnp.where(pool_active, pool_bound, inf), axis=1)
+    outer = jnp.minimum(jnp.minimum(open_min, fathom_floor),
+                        jnp.minimum(lost_bound, incumbent))
+    gap_ok = (incumbent - outer) <= opts.gap_tol \
+        * jnp.maximum(1.0, jnp.abs(incumbent))
+    done = st.done | ~jnp.any(pool_active, axis=1) \
+        | (jnp.isfinite(incumbent) & gap_ok)
+
+    return BnBState(
+        pool_lo=pool_lo, pool_hi=pool_hi, pool_bound=pool_bound,
+        pool_active=pool_active, pool_depth=pool_depth,
+        incumbent=incumbent, x_inc=x_inc,
+        fathom_floor=fathom_floor, lost_bound=lost_bound,
+        x_warm=sol.x, y_warm=sol.y, omega_warm=sol.omega, Lnorm=st.Lnorm,
+        outer=outer, done=done,
+        nodes_solved=st.nodes_solved + has.astype(jnp.int32),
+    )
+
+
+# --------------------------------------------------------------------------
+# Objective feasibility pump (Fischetti-Glover-Lodi; objective variant of
+# Achterberg-Berthold — implemented from the papers' math).  Diving fails
+# on problems whose LP keeps a ~constant pool of fractional ties no
+# matter how many columns are pinned (sslp's capacity-coupled assignment
+# rows); the pump instead alternates
+#     x_lp  = argmin  (alpha) c'x + (1-alpha) dist(x, x_int)
+#     x_int = round(x_lp)                 (half-up)
+# with alpha decaying, where dist is the linear L1-to-rounding over the
+# integer columns (exact for binaries).  Cycles break by flipping the
+# most fractional entries.  Every iteration is ONE batched warm LP solve.
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("opts",))
+def pump_round(qp: BoxQP, d_col: Array, int_cols: Array, xint: Array,
+               alpha: Array, x_warm: Array, y_warm: Array,
+               omega: Array, Lnorm: Array, opts: BnBOptions):
+    """One pump iteration at mixing weight alpha ((S,) in [0,1]).
+    Returns (xi, frac, x, y, omega) where xi is the new LP's integer
+    columns in original space."""
+    S, n = qp.c.shape
+    d_int = jnp.broadcast_to(d_col, (S, n))[:, int_cols]
+    # distance objective in SCALED space: d/dx |d*x - xint| = ±d
+    lo_side = xint <= jnp.broadcast_to(
+        jnp.ceil(jnp.broadcast_to(qp.l, (S, n))[:, int_cols]
+                 * d_int - 1e-6), xint.shape)
+    sgn = jnp.where(lo_side, 1.0, -1.0)                 # binaries: exact
+    c_dist = jnp.zeros((S, n), qp.c.dtype).at[
+        :, int_cols].set(sgn * d_int)
+    cn = qp.c / jnp.maximum(
+        jnp.linalg.norm(qp.c, axis=-1, keepdims=True), 1e-12)
+    dn = c_dist / jnp.maximum(
+        jnp.linalg.norm(c_dist, axis=-1, keepdims=True), 1e-12)
+    a = alpha[:, None]
+    qp_pump = dataclasses.replace(qp, c=a * cn + (1.0 - a) * dn)
+    sol, _, _, _ = _solve_node(qp_pump, x_warm, y_warm, opts.lp,
+                               omega, Lnorm)
+    x_orig = sol.x * jnp.broadcast_to(d_col, sol.x.shape)
+    xi = x_orig[:, int_cols]
+    frac = jnp.abs(xi - jnp.round(xi))
+    return xi, frac, sol.x, sol.y, sol.omega
+
+
+def feasibility_pump(qp: BoxQP, d_col: Array, int_cols: Array,
+                     opts: BnBOptions = BnBOptions(),
+                     rounds: int = 40, alpha_decay: float = 0.85,
+                     x_warm: Array | None = None,
+                     y_warm: Array | None = None,
+                     omega: Array | None = None,
+                     Lnorm: Array | None = None):
+    """Batched objective feasibility pump.  Returns (value (S,),
+    x (S, n) original space, feasible (S,)) for the BEST integer point
+    each scenario's pump visited (evaluated by pinning the rounding and
+    solving the true-objective LP — certified like any incumbent)."""
+    int_cols = jnp.asarray(int_cols, jnp.int32)
+    S, n = qp.c.shape
+    dt = qp.c.dtype
+    if x_warm is None:
+        x_warm = jnp.clip(jnp.zeros((S, n), dt), qp.l, qp.u)
+    if y_warm is None:
+        y_warm = jnp.zeros((S, qp.m), dt)
+    if omega is None:
+        omega = jnp.full((S,), opts.lp.omega0, dt)
+    if Lnorm is None:
+        Lnorm = pdhg.estimate_norm(qp, opts.lp.power_iters).astype(dt)
+
+    lo0, hi0 = _root_bounds(qp, d_col, np.asarray(int_cols))
+    lo0 = jnp.asarray(lo0, dt)
+    hi0 = jnp.asarray(hi0, dt)
+    # root LP under the true objective seeds the rounding
+    qpr = _node_qp(qp, d_col, int_cols, lo0, hi0)
+    sol, _, _, _ = _solve_node(qpr, x_warm, y_warm, opts.lp, omega, Lnorm)
+    x_warm, y_warm, omega = sol.x, sol.y, sol.omega
+    xi = (sol.x * jnp.broadcast_to(d_col, sol.x.shape))[:, int_cols]
+    xint = jnp.clip(jnp.floor(xi + 0.5), lo0, hi0)
+
+    best_val = jnp.full((S,), jnp.inf, dt)
+    best_x = jnp.zeros((S, n), dt)
+    alpha = jnp.ones((S,), dt)
+    prev_key = None
+    rng = np.random.RandomState(23)
+    for r in range(rounds):
+        alpha = alpha * alpha_decay
+        xi, frac, x_warm, y_warm, omega = pump_round(
+            qp, d_col, int_cols, xint, alpha, x_warm, y_warm, omega,
+            Lnorm, opts)
+        new_xint = jnp.clip(jnp.floor(xi + 0.5), lo0, hi0)
+        # evaluate the CURRENT rounding: ONE true-objective solve of the
+        # fully pinned LP
+        qp_pin = _node_qp(qp, d_col, int_cols, new_xint, new_xint)
+        psol, pobj, _, prp = _solve_node(qp_pin, x_warm, y_warm, opts.lp,
+                                         omega, Lnorm)
+        p_feas = (prp <= opts.feas_tol) \
+            & (psol.status != pdhg.INFEASIBLE) \
+            & (psol.status != pdhg.UNBOUNDED)
+        val = jnp.where(p_feas, pobj, jnp.inf)
+        x_f = psol.x * jnp.broadcast_to(d_col, psol.x.shape)
+        better = val < best_val
+        best_val = jnp.where(better, val, best_val)
+        best_x = jnp.where(better[:, None], x_f, best_x)
+        # cycle break: if the rounding did not change, flip the most
+        # fractional entries (deterministic count, seeded)
+        key_now = np.asarray(new_xint)
+        if prev_key is not None and np.array_equal(key_now, prev_key):
+            nflip = 1 + rng.randint(0, 4)
+            fr = np.asarray(frac)
+            idx = np.argsort(-fr, axis=1)[:, :nflip]
+            flip = np.array(key_now)
+            for s in range(S):
+                cols = idx[s]
+                lo_s = np.asarray(lo0)[s, cols]
+                hi_s = np.asarray(hi0)[s, cols]
+                flip[s, cols] = np.where(flip[s, cols] <= lo_s,
+                                         np.minimum(lo_s + 1, hi_s),
+                                         np.maximum(flip[s, cols] - 1,
+                                                    lo_s))
+            new_xint = jnp.asarray(flip, dt)
+        prev_key = np.asarray(new_xint)
+        xint = new_xint
+        if bool(np.all(np.isfinite(np.asarray(best_val)))) \
+                and bool(np.all(np.asarray(frac).max(axis=1) < 1e-3)):
+            break
+    return best_val, best_x, jnp.isfinite(best_val)
+
+
+# --------------------------------------------------------------------------
+# Dive heuristic: fix-and-round to a full integer assignment.
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("opts", "mode"))
+def dive_round(qp: BoxQP, d_col: Array, int_cols: Array,
+               lo: Array, hi: Array, x_warm: Array, y_warm: Array,
+               omega: Array, Lnorm: Array,
+               opts: BnBOptions, mode: str = "wave"):
+    """Solve the current partially-fixed LP, then pin integer columns.
+
+    mode="wave":   pin up to ~nI/8 CONFIDENT columns (frac <= dive_tol)
+                   — bulk progress while the re-solve can still repair
+                   the coupling the pins break;
+    mode="single": pin exactly the most integral unfixed column — the
+                   ambiguous tail, where pinning a coin-flip without a
+                   re-solve in between wrecks coupled rows (observed on
+                   sslp: one wave-pinned ambiguous batch cost +36k);
+    mode="final":  pin everything remaining (the closing solve).
+
+    Returns updated (lo, hi, x, y, omega, obj, feasible)."""
+    qpn = _node_qp(qp, d_col, int_cols, lo, hi)
+    sol, obj, _, rp = _solve_node(qpn, x_warm, y_warm, opts.lp,
+                                  omega, Lnorm, jitter=opts.jitter)
+    x_orig = sol.x * jnp.broadcast_to(d_col, sol.x.shape)
+    xi = x_orig[:, int_cols]
+    frac = jnp.abs(xi - jnp.round(xi))
+    fixed = lo == hi
+    nI = frac.shape[1]
+    S = frac.shape[0]
+    if mode == "final":
+        newfix = ~fixed
+    elif mode == "single":
+        jstar = jnp.argmin(jnp.where(fixed, jnp.inf, frac), axis=1)
+        has_unfixed = ~jnp.all(fixed, axis=1)
+        newfix = jax.nn.one_hot(jstar, nI, dtype=bool) \
+            & has_unfixed[:, None]
+    else:
+        K = max(1, nI // 8)
+        score = jnp.where(fixed, -jnp.inf, -frac)       # bigger = better
+        vals, idx = jax.lax.top_k(score, K)             # K smallest fracs
+        take = vals > -opts.dive_tol                    # confident only
+        newfix = jnp.zeros_like(fixed)
+        newfix = newfix.at[jnp.arange(S)[:, None], idx].set(take)
+        newfix = newfix & ~fixed
+    r = jnp.clip(jnp.floor(xi + 0.5), lo, hi)
+    lo2 = jnp.where(newfix, r, lo)
+    hi2 = jnp.where(newfix, r, hi)
+    feasible = (rp <= opts.feas_tol) & (sol.status != pdhg.INFEASIBLE) \
+        & (sol.status != pdhg.UNBOUNDED)
+    return lo2, hi2, sol.x, sol.y, sol.omega, obj, feasible
+
+
+def _root_bounds(qp: BoxQP, d_col: Array, int_cols: np.ndarray):
+    """ORIGINAL-space integral root box of the integer columns."""
+    S, n = qp.c.shape
+    l_orig = np.broadcast_to(np.asarray(qp.l), (S, n)) \
+        * np.broadcast_to(np.asarray(d_col), (S, n))
+    u_orig = np.broadcast_to(np.asarray(qp.u), (S, n)) \
+        * np.broadcast_to(np.asarray(d_col), (S, n))
+    lo = np.ceil(l_orig[:, int_cols] - 1e-6)
+    hi = np.floor(u_orig[:, int_cols] + 1e-6)
+    return lo, hi
+
+
+def dive(qp: BoxQP, d_col: Array, int_cols: Array,
+         opts: BnBOptions = BnBOptions(),
+         lo: Array | None = None, hi: Array | None = None,
+         x_warm: Array | None = None, y_warm: Array | None = None,
+         omega: Array | None = None, Lnorm: Array | None = None):
+    """Fix-and-round dive to one integer-feasible point per scenario
+    (host loop over jitted rounds).  Returns (value (S,), x (S,n) orig,
+    feasible (S,), warm) where warm = (x, y, omega, Lnorm) for reuse;
+    value is +inf where the dive's end point is infeasible.  This is the
+    cheap certified-incumbent path the round-2 review asked for before
+    full branch-and-bound."""
+    int_cols = jnp.asarray(int_cols)
+    if lo is None or hi is None:
+        lo_np, hi_np = _root_bounds(qp, d_col, np.asarray(int_cols))
+        lo = jnp.asarray(lo_np, qp.c.dtype)
+        hi = jnp.asarray(hi_np, qp.c.dtype)
+    S, n = qp.c.shape
+    dt = qp.c.dtype
+    if x_warm is None:
+        x_warm = jnp.clip(jnp.zeros((S, n), dt), qp.l, qp.u)
+    if y_warm is None:
+        y_warm = jnp.zeros((S, qp.m), dt)
+    if omega is None:
+        omega = jnp.full((S,), opts.lp.omega0, dt)
+    if Lnorm is None:
+        Lnorm = pdhg.estimate_norm(qp, opts.lp.power_iters).astype(dt)
+    def all_fixed():
+        return bool(np.all(np.asarray(lo) == np.asarray(hi)))
+
+    prev_fixed = -1
+    for _ in range(max(1, opts.dive_rounds)):
+        lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
+            qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
+            opts, "wave")
+        nfixed = int((np.asarray(lo) == np.asarray(hi)).sum())
+        if all_fixed() or nfixed == prev_fixed:  # no confident cols left
+            break
+        prev_fixed = nfixed
+    # ambiguous tail: one pin per re-solve
+    for _ in range(opts.dive_tail):
+        if all_fixed():
+            break
+        lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
+            qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
+            opts, "single")
+    # pin any remainder, then one last solve of the fully fixed LP
+    lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
+        qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
+        opts, "final")
+    lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
+        qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
+        opts, "final")
+    value = jnp.where(feas, obj, jnp.inf)
+    x_orig = x_warm * jnp.broadcast_to(d_col, x_warm.shape)
+    return value, x_orig, feas, (x_warm, y_warm, omega, Lnorm)
+
+
+def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
+              opts: BnBOptions = BnBOptions(),
+              x_warm: Array | None = None, y_warm: Array | None = None,
+              verbose: bool = False) -> BnBResult:
+    """Batched exact MIP solve: dive for an incumbent, then best-first
+    branch-and-bound until every scenario's certified gap closes (or the
+    round budget runs out — the bracket stays valid either way).
+
+    qp:       scaled batched BoxQP ((S, n) fields; A may broadcast).
+    d_col:    Ruiz column scaling ((n,) or (S, n)); x_orig = d_col * x.
+    int_cols: int32 indices of integer columns (shared across batch).
+    """
+    int_cols = jnp.asarray(int_cols, jnp.int32)
+    S, n = qp.c.shape
+    dt = qp.c.dtype
+    nI = int(int_cols.shape[0])
+    P = opts.pool_size
+
+    inc, x_inc, feas, warm = dive(qp, d_col, int_cols, opts,
+                                  x_warm=x_warm, y_warm=y_warm)
+    dive_x, dive_y, omega, Lnorm = warm
+    if verbose and bool(np.any(np.asarray(feas))):
+        v = np.asarray(inc)
+        print(f"[bnb] dive incumbents: {v}")
+    if opts.pump_rounds > 0:
+        p_val, p_x, p_feas = feasibility_pump(
+            qp, d_col, int_cols, opts, rounds=opts.pump_rounds,
+            x_warm=dive_x, y_warm=dive_y, omega=omega, Lnorm=Lnorm)
+        better = p_val < inc
+        inc = jnp.where(better, p_val, inc)
+        x_inc = jnp.where(better[:, None], p_x, x_inc)
+        feas = feas | p_feas
+        if verbose:
+            print(f"[bnb] pump incumbents: {np.asarray(p_val)}")
+
+    lo0, hi0 = _root_bounds(qp, d_col, np.asarray(int_cols))
+    pool_lo = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
+        jnp.asarray(lo0, dt))
+    pool_hi = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
+        jnp.asarray(hi0, dt))
+    pool_bound = jnp.full((S, P), jnp.inf, dt).at[:, 0].set(-jnp.inf)
+    pool_active = jnp.zeros((S, P), bool).at[:, 0].set(True)
+
+    st = BnBState(
+        pool_lo=pool_lo, pool_hi=pool_hi, pool_bound=pool_bound,
+        pool_active=pool_active,
+        pool_depth=jnp.zeros((S, P), jnp.int32),
+        incumbent=jnp.where(feas, inc, jnp.inf).astype(dt),
+        x_inc=x_inc.astype(dt),
+        fathom_floor=jnp.full((S,), jnp.inf, dt),
+        lost_bound=jnp.full((S,), jnp.inf, dt),
+        x_warm=dive_x, y_warm=dive_y, omega_warm=omega, Lnorm=Lnorm,
+        outer=jnp.full((S,), -jnp.inf, dt),
+        done=jnp.zeros((S,), bool),
+        nodes_solved=jnp.zeros((S,), jnp.int32),
+    )
+    for r in range(opts.max_rounds):
+        st = bnb_round(qp, d_col, int_cols, st, opts)
+        if bool(np.all(np.asarray(st.done))):
+            break
+        if verbose and (r + 1) % 25 == 0:
+            print(f"[bnb] round {r + 1}: inc={np.asarray(st.incumbent)} "
+                  f"outer={np.asarray(st.outer)}")
+
+    inner = st.incumbent
+    # A scenario that exhausted its pool with no incumbent and no open
+    # nodes has outer = min(fathom_floor, lost) — report as-is.
+    scale = jnp.maximum(1.0, jnp.abs(inner))
+    gap = jnp.where(jnp.isfinite(inner), (inner - st.outer) / scale, jnp.inf)
+    return BnBResult(x=st.x_inc, inner=inner, outer=st.outer, gap=gap,
+                     feasible=jnp.isfinite(inner),
+                     nodes_solved=st.nodes_solved)

@@ -142,6 +142,38 @@ def dual_objective(p: BoxQP, x: Array, y: Array) -> Array:
     return -quad - jnp.sum(ycontrib, axis=-1) + jnp.sum(rccontrib, axis=-1)
 
 
+def certified_dual_bound(p: BoxQP, x: Array, y: Array) -> Array:
+    """A VALID lower bound on the optimal value from ANY iterates (x, y).
+
+    `dual_objective` follows the PDLP accounting convention: adverse
+    pairings of a multiplier/reduced cost with an infinite bound are
+    zeroed and charged to the dual residual — fine for progress metrics,
+    but NOT a bound until the residual clears tolerance.  Branch-and-bound
+    pruning (ops/bnb.py) needs a bound that is valid unconditionally (the
+    role Gurobi's "bestbound" plays, ref:mpisppy/spopt.py:413-436), so:
+
+      * y is first PROJECTED onto the dual-sign cone implied by one-sided
+        rows (y_i >= 0 where bl_i = -inf, y_i <= 0 where bu_i = +inf) —
+        any y gives a valid bound, so projecting is free;
+      * reduced costs pairing adversely with an infinite box bound send
+        the bound to -inf (the honest value of the inner inf), instead of
+        being zeroed.
+
+    For convex QPs the bound is the gradient-linearization dual
+        f(z) >= -1/2 x'Qx - g*(y) + inf_{l<=z<=u} (c + Qx + A'y)'z ,
+    valid for every feasible z by convexity + weak duality.
+    """
+    yp = jnp.where(jnp.isfinite(p.bu), y, jnp.minimum(y, 0.0))
+    yp = jnp.where(jnp.isfinite(p.bl), yp, jnp.maximum(yp, 0.0))
+    gstar = jnp.where(yp > 0.0, p.bu * yp, p.bl * yp)
+    gstar = jnp.where(yp == 0.0, 0.0, gstar)  # guard 0 * inf
+    rc = p.c + p.q * x + p.rmatvec(yp)
+    inf_j = jnp.where(rc > 0.0, p.l * rc, p.u * rc)
+    inf_j = jnp.where(rc == 0.0, 0.0, inf_j)  # guard 0 * inf
+    quad = 0.5 * jnp.sum(p.q * x * x, axis=-1)
+    return -quad - jnp.sum(gstar, axis=-1) + jnp.sum(inf_j, axis=-1)
+
+
 def primal_residual(p: BoxQP, x: Array) -> Array:
     """Per-row distance of Ax from [bl, bu] (0 when feasible)."""
     ax = p.matvec(x)

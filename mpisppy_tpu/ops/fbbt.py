@@ -1,0 +1,250 @@
+###############################################################################
+# Batched feasibility-based bound tightening (FBBT / presolve).
+#
+# The reference's SPPresolve wraps Pyomo APPSI's compiled C interval
+# tightener per subproblem, then Allreduces the nonant bounds across
+# ranks (MAX on lb, MIN on ub) so tightening is consistent scenario-wide
+# (ref:mpisppy/opt/presolve.py:25,61-180,183-260).  TPU-native, a sweep
+# of interval arithmetic over every row of EVERY scenario is one tensor
+# program:
+#
+#   row activity bounds     Lmin_i = sum_j min(a_ij l_j, a_ij u_j)
+#                           Lmax_i = sum_j max(a_ij l_j, a_ij u_j)
+#   per-(row, col) implied  a_ij x_j <= bu_i - (Lmin_i - min-term_ij)
+#   bounds                  a_ij x_j >= bl_i - (Lmax_i - max-term_ij)
+#   column tightening       u_j <- min over rows, l_j <- max over rows
+#   integer rounding        l_j <- ceil(l_j), u_j <- floor(u_j)
+#
+# Dense A uses (m, n) elementwise products; ELL A computes the same
+# quantities on the (m, k) slot arrays with one gather and one
+# scatter-min/max — both static-shape, batched over scenarios on the
+# leading axis, and jit-compiled as a lax.fori_loop over sweeps.
+#
+# The payoff is dual (round-2 review, missing #2): reference parity
+# (consistent nonant bounds), and PDHG conditioning — a smaller feasible
+# box directly shrinks the primal diameter the first-order kernel has to
+# traverse, and tighter integer boxes shrink the branch-and-bound tree.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.ops.boxqp import BoxQP
+
+Array = jax.Array
+
+_BIG = 1e30  # stand-in for inf inside interval arithmetic (avoids inf-inf)
+
+
+def _clean(lo: Array, hi: Array):
+    """Map +-inf to +-_BIG so activity sums never produce NaN."""
+    lo = jnp.clip(lo, -_BIG, _BIG)
+    hi = jnp.clip(hi, -_BIG, _BIG)
+    return lo, hi
+
+
+def _sweep_dense(A: Array, bl: Array, bu: Array, l: Array, u: Array):
+    """One FBBT sweep, dense A ((m, n) or (S, m, n); l,u (..., n))."""
+    lo, hi = _clean(l, u)
+    lo_b = lo[..., None, :]
+    hi_b = hi[..., None, :]
+    t_min = jnp.minimum(A * lo_b, A * hi_b)       # (..., m, n)
+    t_max = jnp.maximum(A * lo_b, A * hi_b)
+    Lmin = jnp.sum(t_min, axis=-1, keepdims=True)
+    Lmax = jnp.sum(t_max, axis=-1, keepdims=True)
+    bl_c = jnp.clip(bl, -_BIG, _BIG)[..., :, None]
+    bu_c = jnp.clip(bu, -_BIG, _BIG)[..., :, None]
+    inf_room = jnp.asarray(jnp.inf, l.dtype)
+    # slack available to column j on each side; rows with an infinite
+    # rhs yield no tightening (the clipped _BIG would otherwise fabricate
+    # a huge-but-INVALID derived bound)
+    up_room = jnp.where(jnp.isfinite(bu)[..., :, None],
+                        bu_c - (Lmin - t_min), inf_room)
+    lo_room = jnp.where(jnp.isfinite(bl)[..., :, None],
+                        bl_c - (Lmax - t_max), -inf_room)
+    pos = A > 0.0
+    neg = A < 0.0
+    inf = jnp.asarray(jnp.inf, l.dtype)
+    Asafe = jnp.where(A == 0.0, 1.0, A)
+    ub_from_up = jnp.where(pos, up_room / Asafe, inf)
+    ub_from_lo = jnp.where(neg, lo_room / Asafe, inf)
+    lb_from_lo = jnp.where(pos, lo_room / Asafe, -inf)
+    lb_from_up = jnp.where(neg, up_room / Asafe, -inf)
+    new_u = jnp.min(jnp.minimum(ub_from_up, ub_from_lo), axis=-2)
+    new_l = jnp.max(jnp.maximum(lb_from_lo, lb_from_up), axis=-2)
+    l2 = jnp.maximum(l, new_l)
+    u2 = jnp.minimum(u, new_u)
+    return l2, u2
+
+
+def _sweep_ell(ell, bl: Array, bu: Array, l: Array, u: Array):
+    """One FBBT sweep on an ops.sparse.EllMatrix (vals (..., m, k),
+    cols (m, k) shared).  Gather column boxes to slots, reduce rows,
+    scatter implied bounds back with segment-min/max."""
+    vals, cols, n = ell.vals, ell.cols, ell.n
+    lo, hi = _clean(l, u)
+    flat = cols.reshape(-1)
+    gl = jnp.take(lo, flat, axis=-1).reshape(lo.shape[:-1] + cols.shape)
+    gu = jnp.take(hi, flat, axis=-1).reshape(hi.shape[:-1] + cols.shape)
+    t_min = jnp.minimum(vals * gl, vals * gu)     # (..., m, k)
+    t_max = jnp.maximum(vals * gl, vals * gu)
+    Lmin = jnp.sum(t_min, axis=-1, keepdims=True)
+    Lmax = jnp.sum(t_max, axis=-1, keepdims=True)
+    bl_c = jnp.clip(bl, -_BIG, _BIG)[..., :, None]
+    bu_c = jnp.clip(bu, -_BIG, _BIG)[..., :, None]
+    inf_room = jnp.asarray(jnp.inf, l.dtype)
+    up_room = jnp.where(jnp.isfinite(bu)[..., :, None],
+                        bu_c - (Lmin - t_min), inf_room)
+    lo_room = jnp.where(jnp.isfinite(bl)[..., :, None],
+                        bl_c - (Lmax - t_max), -inf_room)
+    pos = vals > 0.0
+    neg = vals < 0.0
+    inf = jnp.asarray(jnp.inf, l.dtype)
+    vsafe = jnp.where(vals == 0.0, 1.0, vals)
+    slot_ub = jnp.minimum(jnp.where(pos, up_room / vsafe, inf),
+                          jnp.where(neg, lo_room / vsafe, inf))
+    slot_lb = jnp.maximum(jnp.where(pos, lo_room / vsafe, -inf),
+                          jnp.where(neg, up_room / vsafe, -inf))
+    # scatter-min/max to columns (padding slots carry +-inf: no-ops)
+    bshape = vals.shape[:-2]
+    ub_flat = slot_ub.reshape(bshape + (-1,))
+    lb_flat = slot_lb.reshape(bshape + (-1,))
+    base_u = jnp.full(bshape + (n,), inf, l.dtype)
+    base_l = jnp.full(bshape + (n,), -inf, l.dtype)
+    new_u = base_u.at[..., flat].min(ub_flat)
+    new_l = base_l.at[..., flat].max(lb_flat)
+    l2 = jnp.maximum(l, new_l)
+    u2 = jnp.minimum(u, new_u)
+    return l2, u2
+
+
+@partial(jax.jit, static_argnames=("n_sweeps",))
+def fbbt(qp: BoxQP, n_sweeps: int = 3,
+         d_col: Array | None = None,
+         integer: Array | None = None):
+    """`n_sweeps` of interval tightening over a (possibly batched,
+    possibly Ruiz-scaled) BoxQP.  Returns (l, u) — tightened scaled-space
+    boxes, never looser than the input.
+
+    d_col + integer: when both given, integer columns are rounded to
+    integral ORIGINAL-space bounds each sweep (x_orig = d_col * x), the
+    compiled analog of APPSI's integer handling
+    (ref:mpisppy/opt/presolve.py:61-180).
+    """
+    S_shape = qp.c.shape
+    l0 = jnp.broadcast_to(qp.l, S_shape)
+    u0 = jnp.broadcast_to(qp.u, S_shape)
+    eps = 1e-6
+
+    def round_int(l, u):  # noqa: E741
+        if integer is None or d_col is None:
+            return l, u
+        d = jnp.broadcast_to(d_col, l.shape)
+        l_orig = jnp.ceil(l * d - eps)
+        u_orig = jnp.floor(u * d + eps)
+        return (jnp.where(integer, l_orig / d, l),
+                jnp.where(integer, u_orig / d, u))
+
+    def body(_, lu):
+        l, u = lu  # noqa: E741
+        if hasattr(qp.A, "vals"):
+            l, u = _sweep_ell(qp.A, qp.bl, qp.bu, l, u)  # noqa: E741
+        else:
+            l, u = _sweep_dense(qp.A, qp.bl, qp.bu, l, u)  # noqa: E741
+        return round_int(l, u)
+
+    l, u = jax.lax.fori_loop(0, n_sweeps, body, round_int(l0, u0))  # noqa: E741
+    return l, u
+
+
+def presolve_batch(batch, n_sweeps: int = 3, feas_tol: float = 1e-6,
+                   raise_on_infeasible: bool = True):
+    """Presolve a core.batch.ScenarioBatch: FBBT sweeps on every
+    scenario, then the cross-scenario nonant-bound intersection the
+    reference does with MIN/MAX Allreduces
+    (ref:mpisppy/opt/presolve.py:183-260) — valid because nonanticipative
+    variables are equal across their node's scenarios, so every
+    scenario's implied bound applies to all of them.
+
+    Returns (new_batch, info) where info has 'tightened_bounds' (count
+    of bounds that moved) and 'infeasible' ((S,) bool — empty box
+    detected, the analog of presolve detecting infeasibility).  A
+    provably-infeasible scenario raises ValueError by default: the
+    returned batch clamps empty boxes to a point to stay solvable, and a
+    caller ignoring info['infeasible'] must not mistake that fabricated
+    problem for the real one.  Pass raise_on_infeasible=False to inspect
+    the mask instead."""
+    import numpy as np
+
+    qp = batch.qp
+    S_all = batch.num_scenarios
+    # dense A: the sweep materializes (S, m, n) intermediates, so chunk
+    # the scenario axis to bound device memory at ~2e7 elements (the
+    # ELL path is (S, m, k) and never needs this)
+    if not hasattr(qp.A, "vals") and S_all * qp.m * qp.n > 2e7:
+        chunk = max(1, int(2e7 / (qp.m * qp.n)))
+        ls, us = [], []
+        for s0 in range(0, S_all, chunk):
+            sl = slice(s0, min(s0 + chunk, S_all))
+
+            def cut(x, batched_ndim):
+                return x[sl] if x.ndim == batched_ndim else x
+
+            qp_c = dataclasses.replace(
+                qp, c=cut(qp.c, 2), q=cut(qp.q, 2), A=cut(qp.A, 3),
+                bl=cut(qp.bl, 2), bu=cut(qp.bu, 2),
+                l=cut(qp.l, 2), u=cut(qp.u, 2))
+            lc, uc = fbbt(qp_c, n_sweeps=n_sweeps,
+                          d_col=cut(batch.d_col, 2),
+                          integer=batch.integer_full)
+            ls.append(lc)
+            us.append(uc)
+        l1 = jnp.concatenate(ls, axis=0)
+        u1 = jnp.concatenate(us, axis=0)
+    else:
+        l1, u1 = fbbt(qp, n_sweeps=n_sweeps, d_col=batch.d_col,
+                      integer=batch.integer_full)
+
+    # cross-scenario nonant intersection, in ORIGINAL space, per node
+    S, n = l1.shape
+    d_non = jnp.broadcast_to(batch.d_non, (S, batch.num_nonants))
+    l_non = l1[:, batch.nonant_idx] * d_non
+    u_non = u1[:, batch.nonant_idx] * d_non
+    real = (batch.p > 0.0)[:, None]
+    # per-node max of lower bounds / min of upper bounds over members
+    N = batch.num_nonants
+    nseg = batch.tree.num_nodes * N
+    key = (batch.node_of_slot * N + jnp.arange(N)[None, :]).reshape(-1)
+    big = jnp.asarray(_BIG, l1.dtype)
+    lmax = jax.ops.segment_max(
+        jnp.where(real, l_non, -big).reshape(-1), key, num_segments=nseg
+    ).reshape(batch.tree.num_nodes, N)
+    umin = jax.ops.segment_min(
+        jnp.where(real, u_non, big).reshape(-1), key, num_segments=nseg
+    ).reshape(batch.tree.num_nodes, N)
+    l_non2 = jnp.take_along_axis(lmax, batch.node_of_slot, axis=0)
+    u_non2 = jnp.take_along_axis(umin, batch.node_of_slot, axis=0)
+    l2 = l1.at[:, batch.nonant_idx].max(l_non2 / d_non)
+    u2 = u1.at[:, batch.nonant_idx].min(u_non2 / d_non)
+
+    infeasible = np.asarray(jnp.any(l2 > u2 + feas_tol, axis=1)
+                            & (batch.p > 0.0))
+    if raise_on_infeasible and infeasible.any():
+        raise ValueError(
+            f"FBBT proved scenario(s) {np.nonzero(infeasible)[0].tolist()} "
+            "infeasible (empty variable box after tightening)")
+
+    l0 = np.broadcast_to(np.asarray(qp.l), (S, n))
+    u0 = np.broadcast_to(np.asarray(qp.u), (S, n))
+    moved = (np.asarray(l2) > l0 + 1e-9).sum() \
+        + (np.asarray(u2) < u0 - 1e-9).sum()
+    info = {
+        "tightened_bounds": int(moved),
+        "infeasible": infeasible,
+    }
+    new_qp = dataclasses.replace(qp, l=l2, u=jnp.maximum(l2, u2))
+    return dataclasses.replace(batch, qp=new_qp), info

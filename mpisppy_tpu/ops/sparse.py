@@ -90,6 +90,16 @@ class EllMatrix:
         z = jnp.zeros(cflat.shape[:-1] + (self.n,), cflat.dtype)
         return z.at[..., flat].add(cflat)
 
+    def toarray(self) -> np.ndarray:
+        """Dense (..., m, n) numpy copy — oracle/debug use only."""
+        vals = np.asarray(self.vals)
+        cols = np.asarray(self.cols)
+        out = np.zeros(vals.shape[:-2] + (self.m, self.n), vals.dtype)
+        rows = np.broadcast_to(np.arange(self.m)[:, None], cols.shape)
+        # scatter-ADD duplicates (padding slots add 0 at column 0)
+        np.add.at(out, (..., rows, cols), vals)
+        return out
+
     # -- norms (estimate_norm lower bounds, Ruiz) -------------------------
     def row_sqnorms(self) -> Array:
         return jnp.sum(self.vals * self.vals, axis=-1)

@@ -1,0 +1,52 @@
+###############################################################################
+# Multi-host dry run worker: one PROCESS of a multi-process mesh.
+#
+#   python -m mpisppy_tpu.parallel._multihost_dryrun \
+#       <coordinator> <num_processes> <process_id> <devices_per_process>
+#
+# Builds the farmer batch, shards it over the GLOBAL (cross-process)
+# mesh, runs PH iter0 + one iterk, and prints "CONV <value>" — every
+# process must print the same value (the reductions are global).  This
+# is the process-count-agnostic analog of __graft_entry__'s single-host
+# dryrun_multichip, exercised by tests/test_multihost.py under a
+# 2-process x 4-device virtual CPU topology (gloo collectives), the way
+# the reference validates its MPI layer with `mpiexec -np 2` smoke
+# tests (ref:mpisppy/tests/straight_tests.py:36-44,
+# mpi_one_sided_test.py).
+###############################################################################
+import sys
+
+
+def main():
+    coord, n_proc, pid, dev_per = sys.argv[1:5]
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    mesh_mod.init_multihost(coord, int(n_proc), int(pid),
+                            cpu_devices_per_process=int(dev_per))
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+
+    n_devices = jax.device_count()
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    batch = batch_mod.pad_to_multiple(batch, n_devices)
+    mesh = mesh_mod.make_mesh()
+    batch = mesh_mod.shard_batch(batch, mesh)
+
+    opts = ph_mod.PHOptions(default_rho=1.0, subproblem_windows=4,
+                            iter0_windows=100)
+    rho = jnp.full((batch.num_nonants,), opts.default_rho)
+    state, tb, _ = ph_mod.ph_iter0(batch, rho, opts)
+    state = ph_mod.ph_iterk(batch, state, opts)
+    conv = float(state.conv)
+    print(f"CONV {conv:.6e} TB {float(tb):.6e} "
+          f"procs {jax.process_count()} devices {n_devices}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

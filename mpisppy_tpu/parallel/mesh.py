@@ -26,6 +26,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SCEN_AXIS = "scen"
 
 
+def init_multihost(coordinator_address: str,
+                   num_processes: int,
+                   process_id: int,
+                   cpu_devices_per_process: int | None = None) -> None:
+    """Initialize the multi-host (DCN) runtime — the analog of the
+    reference's `mpiexec` + COMM_WORLD bootstrap
+    (ref:mpisppy/spin_the_wheel.py:224-242): after this, jax.devices()
+    is the GLOBAL device list, make_mesh() spans all hosts, and the
+    scenario-axis reductions inside jitted steps ride ICI within a host
+    and DCN across hosts via the same collectives.
+
+    cpu_devices_per_process: when set (tests / dry runs), forces a
+    virtual CPU topology — N devices per process with gloo collectives
+    — so a 2-process x 4-device mesh runs on one machine with no TPU,
+    the multi-host analog of the conftest virtual mesh.  Must be called
+    before any other jax API touches the backend."""
+    import jax as _jax
+
+    if cpu_devices_per_process is not None:
+        _jax.config.update("jax_platforms", "cpu")
+        _jax.config.update("jax_num_cpu_devices",
+                           int(cpu_devices_per_process))
+        _jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    _jax.distributed.initialize(coordinator_address=coordinator_address,
+                                num_processes=num_processes,
+                                process_id=process_id)
+
+
+def process_local_slice(S: int) -> slice:
+    """This process's contiguous scenario block under the canonical
+    process-major layout (the analog of the reference's
+    _calculate_scenario_ranks block partitioning,
+    ref:mpisppy/spbase.py:188-220)."""
+    import jax as _jax
+
+    P_ = _jax.process_count()
+    if S % P_ != 0:
+        raise ValueError(f"{S} scenarios not divisible by "
+                         f"{P_} processes; pad first")
+    per = S // P_
+    i = _jax.process_index()
+    return slice(i * per, (i + 1) * per)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1-D mesh over the scenario axis.  n_devices=None uses all
     available devices; n_devices=1 is the serial/mock path."""
@@ -80,6 +124,7 @@ def shard_batch(batch, mesh: Mesh):
         nonant_idx=jax.device_put(batch.nonant_idx, repl),
         node_of_slot=put(batch.node_of_slot, 2),
         integer_slot=jax.device_put(batch.integer_slot, repl),
+        integer_full=jax.device_put(batch.integer_full, repl),
         var_prob=None if batch.var_prob is None
         else jax.device_put(batch.var_prob, shard),
     )

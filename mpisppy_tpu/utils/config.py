@@ -125,6 +125,15 @@ class Config:
         self.add_to_config("abs_gap", "absolute termination gap", float,
                            None)
 
+    def presolve_args(self):
+        """Batched FBBT presolve (ref:mpisppy/opt/presolve.py via the
+        reference's 'presolve' option; here ops/fbbt.py)."""
+        self.add_to_config("presolve",
+                           "run FBBT bound tightening on the batch",
+                           bool, False)
+        self.add_to_config("presolve_sweeps",
+                           "FBBT interval-tightening sweeps", int, 3)
+
     def ph_args(self):
         """ref:config.py:250-315."""
         self.popular_args()

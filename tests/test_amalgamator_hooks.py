@@ -1,0 +1,102 @@
+# Amalgamator one-call driver (utils/amalgamator.py, ref
+# utils/amalgamator.py:143-257), the extension callout sequence
+# (ref:mpisppy/phbase.py:829-1061), and the xhat looper/specific spoke
+# variants (ref:cylinders/xhatlooper_bounder.py:23,
+# xhatspecific_bounder.py:25).
+import numpy as np
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.utils import amalgamator
+from mpisppy_tpu.utils.config import Config
+
+
+def _farmer_cfg(**kw):
+    cfg = Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    cfg.quick_assign("num_scens", int, 3)
+    for k, v in kw.items():
+        cfg.quick_assign(k, type(v), v)
+    return cfg
+
+
+def test_amalgamator_ef_farmer():
+    cfg = _farmer_cfg(EF=True)
+    ama = amalgamator.from_module("mpisppy_tpu.models.farmer", cfg)
+    ama.run()
+    # farmer 3-scenario EF objective is the textbook -108390
+    # (ref:examples/farmer/farmer.py + test_ef_ph.py known values)
+    assert abs(ama.EF_Obj - (-108390.0)) / 108390.0 < 1e-3, ama.EF_Obj
+    assert ama.best_inner_bound == ama.best_outer_bound == ama.EF_Obj
+    assert ama.first_stage_solution is not None
+
+
+def test_amalgamator_decomp_farmer():
+    cfg = _farmer_cfg(max_iterations=20, default_rho=1.0,
+                      lagrangian=True, xhatxbar=True, rel_gap=0.01,
+                      display_progress=False)
+    ama = amalgamator.from_module("mpisppy_tpu.models.farmer", cfg)
+    ama.run()
+    assert ama.wheel is not None
+    # bounds bracket the EF optimum
+    assert ama.best_outer_bound <= -108390.0 + 200
+    assert ama.best_inner_bound >= -108390.0 - 200
+    assert ama.first_stage_solution is not None and \
+        len(ama.first_stage_solution) == 3
+
+
+def test_extension_hook_sequence():
+    """Every PH-driven hook fires, in the reference's order
+    (ref:mpisppy/phbase.py:829-1061 callouts)."""
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.extensions.test_extension import TestExtension
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    driver = ph_mod.PH(ph_mod.PHOptions(max_iterations=2),
+                       batch, extensions=TestExtension)
+    driver.ph_main()
+    calls = driver._TestExtension_who_is_called
+    # iter0 sequence
+    assert calls[:4] == ["pre_iter0", "iter0_post_solver_creation",
+                         "post_iter0", "post_iter0_after_sync"], calls
+    # one iterk block
+    k_block = ["miditer", "pre_solve_loop", "post_solve_loop", "enditer",
+               "enditer_after_sync"]
+    assert calls[4:9] == k_block, calls
+    assert calls[-1] == "post_everything", calls
+
+
+def test_xhat_looper_and_specific_spokes():
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.cylinders.spoke import (
+        XhatLooperInnerBound, XhatSpecificInnerBound,
+    )
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    hub = {
+        "hub_class": hub_mod.PHHub,
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_mod.PHOptions(max_iterations=10),
+                       "batch": batch,
+                       "scenario_names": ["scen0", "scen1", "scen2"]},
+        "hub_kwargs": {"options": {"rel_gap": 0.01}},
+    }
+    spokes = [
+        {"spoke_class": XhatLooperInnerBound,
+         "opt_kwargs": {"options": {"scen_limit": 2}}},
+        {"spoke_class": XhatSpecificInnerBound,
+         "opt_kwargs": {"options": {"scenario_names": ["scen1"]}}},
+    ]
+    wheel = WheelSpinner(hub, spokes)
+    wheel.spin()
+    # farmer inner bounds must be >= EF optimum (min problem)
+    assert wheel.BestInnerBound >= -108390.0 - 200.0
+    assert np.isfinite(wheel.BestInnerBound)

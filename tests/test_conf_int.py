@@ -174,3 +174,70 @@ def test_seq_sampling_converged_flag():
     seq = SeqSampling(farmer, bad_gen, cfg, stopping_criterion="BM")
     res = seq.run(maxit=2)
     assert res["converged"] is False
+
+
+def test_multistage_gap_estimators():
+    """gap_estimators_mstage: small aircond trees; G >= 0 near a
+    reasonable candidate, seed advances by the trees' node counts."""
+    from mpisppy_tpu.confidence_intervals.sample_tree import (
+        SampleSubtree, _number_of_nodes,
+    )
+    cfg = _cfg(4)
+    cfg.quick_assign("branching_factors", list, [2, 2])
+    # candidate: root solution of one sampled tree
+    st = SampleSubtree(aircond, None, (2, 2), seed=3, cfg=cfg)
+    st.run()
+    sol = st.ef.x
+    nonant_idx = np.asarray(st.ef.ef.nonant_idx)
+    tree = st.ef.ef.tree
+    root_slots = np.nonzero(tree.slot_stage == 1)[0]
+    xhat_root = sol[:, nonant_idx].mean(axis=0)[root_slots]
+
+    est = ciutils.gap_estimators_mstage(
+        xhat_root, aircond, 3, cfg, start_seed=50,
+        branching_factors=[2, 2])
+    assert est["G"] >= 0.0
+    assert est["s"] >= 0.0
+    assert est["seed"] == 50 + 3 * _number_of_nodes([2, 2])
+
+
+def test_multistage_seq_sampling_aircond():
+    """IndepScens_SeqSampling on 3-stage aircond (the round-2 review's
+    missing #4; ref:test_conf_int_aircond.py style)."""
+    from mpisppy_tpu.confidence_intervals.seqsampling import (
+        IndepScens_SeqSampling,
+    )
+    cfg = _cfg(4, BM_h=5.0, BM_hprime=0.2, BM_eps=150.0,
+               BM_eps_prime=120.0, confidence_level=0.9)
+    cfg.quick_assign("branching_factors", list, [2, 2])
+    seq = IndepScens_SeqSampling(aircond, None, cfg,
+                                 stopping_criterion="BM")
+    res = seq.run(maxit=5)
+    assert res["T"] <= 5
+    assert res["CI"][0] == 0.0 and np.isfinite(res["CI"][1])
+    assert len(res["Candidate_solution"]) == 2  # aircond root nonants
+
+
+def test_mmw_conf_cli(tmp_path):
+    """The mmw_conf CLI end-to-end on farmer (ref:mmw_conf.py)."""
+    import json
+    import contextlib
+    import io
+
+    from mpisppy_tpu.confidence_intervals import mmw_conf
+
+    xhat_path = str(tmp_path / "xhat.npy")
+    ciutils.write_xhat(XHAT_STAR, xhat_path)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        res = mmw_conf.main([
+            "--module-name", "mpisppy_tpu.models.farmer",
+            "--xhatpath", xhat_path,
+            "--num-scens", "10",
+            "--MMW-num-batches", "2",
+            "--MMW-batch-size", "8",
+        ])
+    assert res["Gbar"] >= 0.0
+    line = buf.getvalue().strip().splitlines()[-1]
+    out = json.loads(line)
+    assert "gap_inner_bound" in out

@@ -25,7 +25,8 @@ def scipy_ef_solve(specs):
     efp = ef_mod.build_ef(specs, scale=False)
     qp = efp.qp
     c = np.asarray(qp.c, np.float64)
-    A = np.asarray(qp.A, np.float64)
+    A = np.asarray(qp.A.toarray() if hasattr(qp.A, "toarray") else qp.A,
+                   np.float64)
     bl, bu = np.asarray(qp.bl, np.float64), np.asarray(qp.bu, np.float64)
     l, u = np.asarray(qp.l, np.float64), np.asarray(qp.u, np.float64)
     A_ub, b_ub, A_eq, b_eq = [], [], [], []

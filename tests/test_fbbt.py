@@ -1,0 +1,154 @@
+# Presolve / FBBT plane (ops/fbbt.py) — semantics parity with the
+# reference's SPPresolve + cross-rank nonant bound reduction
+# (ref:mpisppy/opt/presolve.py:61-260).
+import numpy as np
+import jax.numpy as jnp
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import sslp
+from mpisppy_tpu.ops import boxqp, fbbt, pdhg, sparse as sparse_mod
+
+
+def _qp(c, A, bl, bu, l, u):  # noqa: E741
+    z = np.zeros_like(np.asarray(c, float))
+    return boxqp.BoxQP(
+        c=jnp.asarray(c, jnp.float32), q=jnp.asarray(z, jnp.float32),
+        A=jnp.asarray(A, jnp.float32), bl=jnp.asarray(bl, jnp.float32),
+        bu=jnp.asarray(bu, jnp.float32), l=jnp.asarray(l, jnp.float32),
+        u=jnp.asarray(u, jnp.float32))
+
+
+def test_fbbt_hand_example():
+    # 2x + 3y <= 6, x,y >= 0  =>  x <= 3, y <= 2
+    qp = _qp([1.0, 1.0], [[2.0, 3.0]], [-np.inf], [6.0],
+             [0.0, 0.0], [np.inf, np.inf])
+    l, u = fbbt.fbbt(qp, n_sweeps=2)  # noqa: E741
+    assert np.allclose(np.asarray(u), [3.0, 2.0], atol=1e-5)
+    assert np.allclose(np.asarray(l), [0.0, 0.0], atol=1e-5)
+
+
+def test_fbbt_integer_rounding():
+    # 2x + 2y <= 5 with x,y integer => x,y <= floor(2.5) = 2
+    qp = _qp([1.0, 1.0], [[2.0, 2.0]], [-np.inf], [5.0],
+             [0.0, 0.0], [10.0, 10.0])
+    l, u = fbbt.fbbt(qp, n_sweeps=2, d_col=jnp.ones(2),  # noqa: E741
+                     integer=jnp.ones(2, bool))
+    assert np.allclose(np.asarray(u), [2.0, 2.0], atol=1e-5)
+
+
+def test_fbbt_equality_propagation():
+    # x + y == 4, 0<=x<=1  =>  3 <= y <= 4
+    qp = _qp([0.0, 0.0], [[1.0, 1.0]], [4.0], [4.0],
+             [0.0, 0.0], [1.0, 10.0])
+    l, u = fbbt.fbbt(qp, n_sweeps=2)  # noqa: E741
+    assert np.allclose(np.asarray(l), [0.0, 3.0], atol=1e-5)
+    assert np.allclose(np.asarray(u), [1.0, 4.0], atol=1e-5)
+
+
+def test_fbbt_ell_matches_dense():
+    rng = np.random.RandomState(5)
+    m, n = 6, 9
+    A = rng.randn(m, n) * (rng.rand(m, n) < 0.5)
+    bu = rng.rand(m) * 4 + 1
+    bl = np.full(m, -np.inf)
+    l = np.zeros(n)  # noqa: E741
+    u = np.full(n, 5.0)
+    qp_d = _qp(rng.randn(n), A, bl, bu, l, u)
+    import scipy.sparse as sps
+    ell = sparse_mod.ell_from_scipy(sps.csr_matrix(A), jnp.float32)
+    import dataclasses
+    qp_s = dataclasses.replace(qp_d, A=ell)
+    ld, ud = fbbt.fbbt(qp_d, n_sweeps=3)
+    ls, us = fbbt.fbbt(qp_s, n_sweeps=3)
+    assert np.allclose(np.asarray(ld), np.asarray(ls), atol=1e-4)
+    assert np.allclose(np.asarray(ud), np.asarray(us), atol=1e-4)
+
+
+def test_fbbt_never_cuts_optimum():
+    """Tightened boxes must preserve the LP optimum (validity)."""
+    rng = np.random.RandomState(7)
+    S, m, n = 3, 5, 7
+    c = rng.randn(S, n)
+    A = rng.randn(S, m, n) * (rng.rand(S, m, n) < 0.6)
+    x0 = rng.rand(S, n)
+    bu = np.einsum("smn,sn->sm", A, x0) + 0.3
+    qp = _qp(c, A, np.full((S, m), -np.inf), bu,
+             np.zeros((S, n)), np.full((S, n), 3.0))
+    st0 = pdhg.solve(qp, pdhg.PDHGOptions(tol=1e-7))
+    obj0 = np.asarray(jnp.sum(qp.c * st0.x, axis=-1))
+    l2, u2 = fbbt.fbbt(qp, n_sweeps=3)
+    import dataclasses
+    qp2 = dataclasses.replace(qp, l=l2, u=u2)
+    st1 = pdhg.solve(qp2, pdhg.PDHGOptions(tol=1e-7))
+    obj1 = np.asarray(jnp.sum(qp2.c * st1.x, axis=-1))
+    assert np.allclose(obj0, obj1, atol=1e-3 * (1 + np.abs(obj0).max()))
+
+
+def test_presolve_batch_sslp():
+    """Presolving the sslp batch tightens bounds (the dummy overflow
+    columns get demand-sum-implied boxes; binaries stay [0,1]) and
+    preserves every scenario's LP optimum + the PH trivial bound."""
+    inst = sslp.synthetic_instance(5, 10, seed=1)
+    names = sslp.scenario_names_creator(6)
+    specs = [sslp.scenario_creator(nm, instance=inst, num_scens=6)
+             for nm in names]
+    batch = batch_mod.from_specs(specs)
+    st0 = pdhg.solve(batch.qp, pdhg.PDHGOptions(tol=1e-6))
+    obj0 = np.asarray(batch.objective(st0.x))
+
+    pre, info = fbbt.presolve_batch(batch, n_sweeps=3)
+    assert info["tightened_bounds"] > 0
+    assert not info["infeasible"].any()
+    st1 = pdhg.solve(pre.qp, pdhg.PDHGOptions(tol=1e-6))
+    obj1 = np.asarray(pre.objective(st1.x))
+    assert np.allclose(obj0, obj1, rtol=1e-3, atol=1e-2), (obj0, obj1)
+
+
+def test_presolve_cross_scenario_nonant_intersection():
+    """A bound implied in ONE scenario must propagate to all scenarios'
+    nonant boxes (ref:mpisppy/opt/presolve.py:183-260 Allreduce
+    semantics)."""
+    # two scenarios; scenario 1's row x0 <= 2 must tighten scenario 0 too
+    import dataclasses
+    from mpisppy_tpu.core.batch import ScenarioSpec
+    mk = lambda name, bu0: ScenarioSpec(  # noqa: E731
+        name=name,
+        c=np.array([1.0, 1.0]),
+        A=np.array([[1.0, 0.0]]),
+        bl=np.array([-np.inf]),
+        bu=np.array([bu0]),
+        l=np.zeros(2),
+        u=np.array([10.0, 10.0]),
+        nonant_idx=np.array([0], np.int32),
+    )
+    specs = [mk("s0", 9.0), mk("s1", 2.0)]
+    batch = batch_mod.from_specs(specs, scale=False)
+    pre, info = fbbt.presolve_batch(batch, n_sweeps=2)
+    u_non = np.asarray(pre.qp.u)[:, 0] * np.broadcast_to(
+        np.asarray(pre.d_col), np.asarray(pre.qp.u).shape)[:, 0]
+    assert np.all(u_non <= 2.0 + 1e-5), u_non
+
+
+def test_presolve_detects_infeasible_scenario():
+    from mpisppy_tpu.core.batch import ScenarioSpec
+    # x0 + x1 >= 5 with boxes [0,1] is infeasible
+    sp_bad = ScenarioSpec(
+        name="bad", c=np.zeros(2), A=np.array([[1.0, 1.0]]),
+        bl=np.array([5.0]), bu=np.array([np.inf]),
+        l=np.zeros(2), u=np.ones(2), nonant_idx=np.array([0], np.int32))
+    sp_ok = ScenarioSpec(
+        name="ok", c=np.zeros(2), A=np.array([[1.0, 1.0]]),
+        bl=np.array([1.0]), bu=np.array([np.inf]),
+        l=np.zeros(2), u=np.ones(2), nonant_idx=np.array([0], np.int32))
+    import pytest
+    batch = batch_mod.from_specs([sp_ok, sp_bad], scale=False)
+    with pytest.raises(ValueError, match="infeasible"):
+        fbbt.presolve_batch(batch, n_sweeps=3)
+    _, info = fbbt.presolve_batch(batch, n_sweeps=3,
+                                  raise_on_infeasible=False)
+    assert bool(info["infeasible"][1])
+    # the cross-scenario MAX/MIN reduction propagates the empty nonant
+    # box to every member scenario — correct: one infeasible scenario
+    # makes the whole stochastic program infeasible (same effect as the
+    # reference's bound Allreduce, ref:mpisppy/opt/presolve.py:183-260)
+    assert info["infeasible"].all()

@@ -1,0 +1,237 @@
+# Exact-MIP path: batched branch-and-bound (ops/bnb.py, algos/mip.py)
+# oracle-tested against scipy.optimize.milp (HiGHS) — the same
+# independent-oracle strategy the LP tests use with scipy.linprog, in
+# the role Gurobi plays for the reference's tests
+# (ref:mpisppy/tests/utils.py:14-34 solver-adaptive fixtures).
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import sslp
+from mpisppy_tpu.ops import bnb, boxqp, pdhg
+from mpisppy_tpu.ops.bnb import BnBOptions
+
+
+def milp_oracle(c, A, bl, bu, l, u, integer):  # noqa: E741
+    from scipy.optimize import Bounds, LinearConstraint, milp
+    res = milp(c, constraints=LinearConstraint(A, bl, bu),
+               bounds=Bounds(l, u), integrality=integer.astype(int))
+    return res
+
+
+def random_mips(S=4, n=8, m=5, seed=3):
+    """Batch of random feasible bounded MIPs + their oracle optima."""
+    rng = np.random.RandomState(seed)
+    c = rng.randn(S, n)
+    A = rng.randn(S, m, n) * (rng.rand(S, m, n) < 0.6)
+    x0 = rng.randint(0, 3, size=(S, n)).astype(float)
+    bu = np.einsum("smn,sn->sm", A, x0) + rng.rand(S, m) * 2.0
+    bl = np.full((S, m), -np.inf)
+    l = np.zeros((S, n))  # noqa: E741
+    u = np.full((S, n), 4.0)
+    integer = np.ones(n, bool)
+    opts = [milp_oracle(c[s], A[s], bl[s], bu[s], l[s], u[s], integer)
+            for s in range(S)]
+    assert all(r.success for r in opts)
+    qp = boxqp.BoxQP(
+        c=jnp.asarray(c, jnp.float32), q=jnp.zeros((S, n), jnp.float32),
+        A=jnp.asarray(A, jnp.float32), bl=jnp.asarray(bl, jnp.float32),
+        bu=jnp.asarray(bu, jnp.float32), l=jnp.asarray(l, jnp.float32),
+        u=jnp.asarray(u, jnp.float32))
+    return qp, integer, np.array([r.fun for r in opts])
+
+
+def test_bnb_matches_milp_oracle():
+    qp, integer, ref = random_mips()
+    res = bnb.solve_mip(qp, jnp.ones(qp.c.shape[-1], jnp.float32),
+                        np.nonzero(integer)[0].astype(np.int32),
+                        BnBOptions(pool_size=32, max_rounds=300))
+    inner = np.asarray(res.inner)
+    outer = np.asarray(res.outer)
+    scale = 1.0 + np.abs(ref)
+    # the certified bracket must contain the oracle optimum
+    assert np.all(outer <= ref + 1e-3 * scale), (outer, ref)
+    assert np.all(inner >= ref - 1e-3 * scale), (inner, ref)
+    # and close it
+    assert np.all(np.abs(inner - ref) <= 2e-3 * scale), (inner, ref)
+
+
+def test_certified_dual_bound_is_valid_anywhere():
+    """certified_dual_bound must lower-bound the LP optimum from ANY
+    iterates — including garbage ones (that is what pruning relies on)."""
+    from scipy.optimize import linprog
+    rng = np.random.RandomState(0)
+    n, m = 6, 4
+    c = rng.randn(n)
+    A = rng.randn(m, n)
+    x0 = rng.rand(n) * 2
+    bu = A @ x0 + 0.5
+    l = np.zeros(n)  # noqa: E741
+    u = np.full(n, 3.0)
+    ref = linprog(c, A_ub=A, b_ub=bu, bounds=list(zip(l, u)), method="highs")
+    assert ref.success
+    qp = boxqp.BoxQP(
+        c=jnp.asarray(c[None], jnp.float32),
+        q=jnp.zeros((1, n), jnp.float32),
+        A=jnp.asarray(A, jnp.float32),
+        bl=jnp.asarray(np.full(m, -np.inf)[None], jnp.float32),
+        bu=jnp.asarray(bu[None], jnp.float32),
+        l=jnp.asarray(l[None], jnp.float32),
+        u=jnp.asarray(u[None], jnp.float32))
+    for seed in range(5):
+        r2 = np.random.RandomState(seed)
+        x = jnp.asarray(r2.randn(1, n), jnp.float32)
+        y = jnp.asarray(r2.randn(1, m), jnp.float32)
+        b = float(boxqp.certified_dual_bound(qp, x, y)[0])
+        assert b <= ref.fun + 1e-4 * (1 + abs(ref.fun)), (b, ref.fun)
+    # at the PDHG solution the bound is tight
+    st = pdhg.solve(qp, pdhg.PDHGOptions(tol=1e-7))
+    b = float(boxqp.certified_dual_bound(qp, st.x, st.y)[0])
+    assert abs(b - ref.fun) <= 1e-3 * (1 + abs(ref.fun))
+
+
+@pytest.fixture(scope="module")
+def small_sslp_batch():
+    """Synthetic sslp small enough for oracle MIP solves."""
+    inst = sslp.synthetic_instance(4, 8, seed=2)
+    names = sslp.scenario_names_creator(4)
+    specs = [sslp.scenario_creator(nm, instance=inst, num_scens=4)
+             for nm in names]
+    return specs, batch_mod.from_specs(specs)
+
+
+def _sslp_ef_oracle(specs):
+    from mpisppy_tpu.algos import ef as ef_mod
+    efp = ef_mod.build_ef(specs, scale=False, sparse=False)
+    integer = np.zeros(efp.qp.c.shape[-1], bool)
+    n = efp.n_per_scen
+    for s, sp in enumerate(specs):
+        integer[s * n:(s + 1) * n] = sp.integer
+    r = milp_oracle(np.asarray(efp.qp.c, float), np.asarray(efp.qp.A, float),
+                    np.asarray(efp.qp.bl, float), np.asarray(efp.qp.bu, float),
+                    np.asarray(efp.qp.l, float), np.asarray(efp.qp.u, float),
+                    integer)
+    assert r.success
+    return r.fun
+
+
+def test_ef_mip_matches_oracle(small_sslp_batch):
+    from mpisppy_tpu.algos import ef as ef_mod, mip
+    specs, _ = small_sslp_batch
+    ref = _sslp_ef_oracle(specs)
+    efp = ef_mod.build_ef(specs)
+    r = mip.ef_mip(efp, specs,
+                   BnBOptions(gap_tol=1e-3, pool_size=64, max_rounds=300))
+    scale = 1.0 + abs(ref)
+    assert r["outer"] <= ref + 2e-3 * scale, (r, ref)
+    assert r["inner"] >= ref - 2e-3 * scale, (r, ref)
+    assert abs(r["inner"] - ref) <= 5e-3 * scale, (r, ref)
+
+
+def test_certified_mip_gap_brackets_oracle(small_sslp_batch):
+    from mpisppy_tpu.algos import mip, ph as ph_mod
+    specs, batch = small_sslp_batch
+    ref = _sslp_ef_oracle(specs)
+    res = mip.certified_mip_gap(
+        batch, ph_mod.PHOptions(max_iterations=40, default_rho=10.0),
+        BnBOptions(gap_tol=1e-3, pool_size=32, max_rounds=200))
+    scale = 1.0 + abs(ref)
+    assert res.outer <= ref + 2e-3 * scale, (res.outer, ref)
+    assert res.inner >= ref - 2e-3 * scale, (res.inner, ref)
+    assert res.gap <= 0.02, res
+
+
+def test_evaluate_mip_integer_recourse(small_sslp_batch):
+    """Integer-recourse xhat evaluation >= LP-recourse evaluation, and
+    matches per-scenario oracle MIPs with the first stage fixed."""
+    from mpisppy_tpu.algos import mip, xhat as xhat_mod
+    specs, batch = small_sslp_batch
+    nsrv = int(np.asarray(batch.integer_slot).shape[0])
+    xhat = np.ones(nsrv)  # open all servers: recourse surely feasible
+    ev = mip.evaluate_mip(batch, jnp.asarray(xhat, jnp.float32),
+                          BnBOptions(gap_tol=1e-3, pool_size=32,
+                                     max_rounds=200))
+    assert ev["feasible"]
+    lp = float(xhat_mod.evaluate(batch, jnp.asarray(xhat, jnp.float32)).value)
+    assert ev["value"] >= lp - 1e-3 * (1 + abs(lp))
+    # oracle per scenario: fix x = 1 and MIP the recourse
+    vals = []
+    for sp in specs:
+        l = sp.l.copy()  # noqa: E741
+        u = sp.u.copy()
+        l[sp.nonant_idx] = xhat
+        u[sp.nonant_idx] = xhat
+        r = milp_oracle(sp.c, sp.A, sp.bl, sp.bu, l, u, sp.integer)
+        assert r.success
+        vals.append(r.fun)
+    ref = float(np.mean(vals))
+    assert abs(ev["value"] - ref) <= 2e-3 * (1 + abs(ref)), (ev["value"], ref)
+
+
+REF_1545 = "/root/reference/examples/sslp/data/sslp_15_45_5/scenariodata"
+_SLOW = __import__("os").environ.get("RUN_SLOW_MIP") == "1"
+
+
+@pytest.mark.skipif(not __import__("os").path.isdir(REF_1545),
+                    reason="reference sslp data not mounted")
+def test_sslp_15_45_5_certified_bracket():
+    """Real SIPLIB sslp_15_45_5 data: the certified (inner, outer)
+    bracket must contain SIPLIB's published optimum -262.400, and the
+    inner bound must be a true integer-feasible value within 1% of it.
+    The full <0.5%-gap certification (dd-bnb to closure) is minutes of
+    batched B&B — run by bench.py on the TPU and under RUN_SLOW_MIP=1
+    here (test_sslp_15_45_5_certified_gap_slow)."""
+    from mpisppy_tpu.algos import mip, ph as ph_mod
+    from mpisppy_tpu.algos import xhat as xhat_mod
+    import jax.numpy as jnp
+
+    names = sslp.scenario_names_creator(5)
+    specs = [sslp.scenario_creator(nm, data_dir=REF_1545, num_scens=5)
+             for nm in names]
+    batch = batch_mod.from_specs(specs)
+    drv = ph_mod.PH(ph_mod.PHOptions(max_iterations=60, default_rho=5.0),
+                    batch)
+    drv.ph_main()
+    # inner: MIP-evaluate the best scenario-x candidate
+    x_non = batch.nonants(drv.state.solver.x)
+    cands = [xhat_mod.round_integers(batch, x_non[s]) for s in range(5)]
+    lp_vals = [float(xhat_mod.evaluate(batch, c).value) for c in cands]
+    best = cands[int(np.argmin(lp_vals))]
+    opts = BnBOptions(gap_tol=2e-3, pool_size=64, max_rounds=80,
+                      pump_rounds=10)
+    ev = mip.evaluate_mip(batch, jnp.asarray(best), opts)
+    assert ev["feasible"]
+    inner = ev["value"]
+    # outer: Lagrangian MIP bound at PH's W (certified)
+    outer = mip.lagrangian_mip_bound(batch, drv.state.W, opts)["bound"]
+    # SIPLIB's published optimum for sslp_15_45_5 is -262.400: the
+    # certified bracket must contain it
+    assert outer <= -262.4 + 0.5, (outer, inner)
+    assert inner >= -262.4 - 0.5, (outer, inner)
+    # the recourse B&B's own lower bracket at this candidate must come
+    # out near the optimum (the per-scenario bounds are the certificate;
+    # full inner-side closure to <0.5% is the gated slow test / bench)
+    assert ev["value_lower"] <= -255.0, ev["value_lower"]
+
+
+@pytest.mark.skipif(not (_SLOW and __import__("os").path.isdir(REF_1545)),
+                    reason="set RUN_SLOW_MIP=1 (minutes of batched B&B "
+                           "on CPU; bench.py runs this on the TPU)")
+def test_sslp_15_45_5_certified_gap_slow():
+    """The round-2 review's Done criterion: real SIPLIB sslp_15_45_5
+    to a certified MIP gap under 0.5% (first-stage dd-bnb closes the
+    duality gap the root Lagrangian bound leaves)."""
+    from mpisppy_tpu.algos import mip, ph as ph_mod
+    names = sslp.scenario_names_creator(5)
+    specs = [sslp.scenario_creator(nm, data_dir=REF_1545, num_scens=5)
+             for nm in names]
+    batch = batch_mod.from_specs(specs)
+    res = mip.certified_mip_gap(
+        batch, ph_mod.PHOptions(max_iterations=200, default_rho=5.0,
+                                subproblem_windows=16),
+        BnBOptions(gap_tol=2e-3, pool_size=64, max_rounds=200),
+        ascent_steps=2, target_gap=4e-3, dd_nodes=60)
+    assert np.isfinite(res.inner)
+    assert res.outer <= -262.4 + 0.5 and res.inner >= -262.4 - 0.5, res
+    assert res.gap <= 0.005, res

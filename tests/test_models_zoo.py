@@ -132,7 +132,9 @@ def test_uc_ef_and_ph():
              for nm in names]
     sobj, xref = scipy_ef_solve(specs)
     b = batch_mod.from_specs(specs)
-    algo, (conv, eobj, tb) = _ph(b, rho=50.0, iters=300, conv=1e-2,
+    # rho ~ startup-cost scale: the min-up/down + startup structure added
+    # in round 3 stiffens the commitment consensus (rho=50 stalls ~2e-2)
+    algo, (conv, eobj, tb) = _ph(b, rho=200.0, iters=300, conv=1e-2,
                                  windows=10)
     assert tb <= sobj * (1 + 1e-3)
     assert conv <= 1e-2

@@ -1,0 +1,47 @@
+# Multi-host (multi-process) mesh path: 2 processes x 4 virtual CPU
+# devices with gloo collectives — the DCN analog of the conftest's
+# 8-device virtual mesh (round-2 review, missing #10; reference analog:
+# `mpiexec -np 2` smoke tests, ref:mpisppy/tests/straight_tests.py).
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_four_device_dryrun():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo
+    cmd = [sys.executable, "-m",
+           "mpisppy_tpu.parallel._multihost_dryrun", coord, "2"]
+    procs = [subprocess.Popen(cmd + [str(pid), "4"], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL, text=True)
+             for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=550)
+        assert p.returncode == 0, out
+        outs.append(out)
+    convs = []
+    for out in outs:
+        m = re.search(r"CONV ([\d.e+-]+) TB ([\d.e+-]+) procs (\d+) "
+                      r"devices (\d+)", out)
+        assert m, out
+        assert m.group(3) == "2" and m.group(4) == "8", out
+        convs.append(float(m.group(1)))
+    # global reductions: both processes must compute the SAME conv
+    assert convs[0] == pytest.approx(convs[1], rel=1e-6), convs

@@ -76,7 +76,7 @@ def test_sc_sslp_lp_relaxation():
     sobj, _ = scipy_ef_solve(specs)
     b = batch_mod.from_specs(specs)
     # degenerate set-cover vertices need a deep central path: tol 1e-9
-    sc = SchurComplement(SCOptions(max_iter=150, tol=1e-9), b)
+    sc = SchurComplement(SCOptions(max_iter=250, tol=1e-10), b)
     res = sc.solve()
     assert res["converged"]
     assert res["objective"] == pytest.approx(sobj, rel=1e-4)
@@ -96,3 +96,18 @@ def test_sc_rejects_integer_and_multistage():
     hb = batch_mod.from_specs(hspecs, tree=hydro.make_tree())
     with pytest.raises(ValueError, match="two-stage"):
         SchurComplement(SCOptions(), hb)
+
+
+def test_sc_backend_and_timing_recorded():
+    """The CPU-offload boundary is explicit (round-2 review, weak #4):
+    the result records which backend the f64 loop ran on and how long
+    it took; under the test harness (cpu default) no offload happens."""
+    import jax
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    sc = SchurComplement({}, batch)
+    res = sc.solve()
+    assert res["backend_used"] == jax.default_backend() == "cpu"
+    assert res["solve_seconds"] > 0.0
+    assert res["converged"]
