@@ -115,7 +115,8 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts):
         "hub_class": hub_mod.PHHub,
         "opt_class": ph_mod.PH,
         "opt_kwargs": {"options": ph_opts, "batch": batch},
-        "hub_kwargs": {"options": {"rel_gap": GAP_TARGET}},
+        "hub_kwargs": {"options": {"rel_gap": GAP_TARGET,
+                                   "spoke_sync_period": 3}},
     }
     t0 = time.perf_counter()
     wheel = WheelSpinner(hub, spokes_cfg)
@@ -147,11 +148,16 @@ def bench_sslp_gap():
         default_rho=20.0, max_iterations=MAX_WHEEL_ITERS, conv_thresh=0.0,
         subproblem_windows=8,
         pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+    # spokes carry warm state across syncs, so a capped per-sync budget
+    # converges over a few syncs; uncapped spokes cost ~150x bare PH per
+    # iteration (measured) while bound certification gates acceptance
+    # either way
+    spoke_pdhg = pdhg.PDHGOptions(tol=1e-6, max_iters=4_000)
     spokes = [
         {"spoke_class": spoke_mod.LagrangianOuterBound,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
         {"spoke_class": spoke_mod.XhatXbarInnerBound,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
     ]
     out = bench_wheel_to_gap(batch, f"sslp_15_45_{SSLP_SCENS}scen",
                              spokes, ph_opts)
@@ -239,15 +245,16 @@ def bench_wheel_overhead():
         "opt_kwargs": {"options": ph_opts, "batch": batch},
         "hub_kwargs": {"options": {"rel_gap": 0.0}},
     }
+    spoke_pdhg = pdhg.PDHGOptions(tol=1e-6, max_iters=4_000)
     spokes = [
         {"spoke_class": spoke_mod.LagrangianOuterBound,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
         {"spoke_class": spoke_mod.XhatXbarInnerBound,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
         {"spoke_class": spoke_mod.XhatShuffleInnerBound,
-         "opt_kwargs": {"options": {"k": 2}}},
+         "opt_kwargs": {"options": {"k": 2, "pdhg_opts": spoke_pdhg}}},
         {"spoke_class": spoke_mod.SlamMaxHeuristic,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
     ]
     wheel = WheelSpinner(hub, spokes)
     wheel.spin()
@@ -285,11 +292,20 @@ def bench_uc_fwph():
         conv_thresh=0.0,
         subproblem_windows=10,
         pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+    spoke_pdhg = pdhg.PDHGOptions(tol=1e-6, max_iters=4_000)
+    # slam-max commits every unit any scenario wants: the conservative
+    # feasible commitment (rounded-xbar undercommits against the
+    # reserve rows and pays shortfall penalties)
     spokes = [
         {"spoke_class": spoke_mod.FWPHOuterBound,
-         "opt_kwargs": {"options": {"rho": 200.0}}},
+         "opt_kwargs": {"options": {"rho": 200.0,
+                                    "pdhg_opts": spoke_pdhg}}},
+        {"spoke_class": spoke_mod.LagrangianOuterBound,
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
         {"spoke_class": spoke_mod.XhatXbarInnerBound,
-         "opt_kwargs": {"options": {}}},
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
+        {"spoke_class": spoke_mod.SlamMaxHeuristic,
+         "opt_kwargs": {"options": {"pdhg_opts": spoke_pdhg}}},
     ]
     return bench_wheel_to_gap(batch, f"uc_10g24h_{UC_SCENS}scen",
                               spokes, ph_opts)

@@ -74,12 +74,17 @@ class Hub(SPCommunicator):
             global_toc(f"Terminating: abs_gap {abs_gap:.4e} <= "
                        f"{opt['abs_gap']}", True)
             return True
-        if "max_stalled_iters" in opt and (
-                self._iter - self._inner_bound_update_iter
-                >= opt["max_stalled_iters"]
-                and self.BestInnerBound < math.inf):
-            global_toc("Terminating: inner bound stalled", True)
-            return True
+        if "max_stalled_iters" in opt:
+            # spokes only produce results on exchange iterations, so the
+            # stall budget counts in EXCHANGE rounds (with
+            # spoke_sync_period=k, intermediate iterations cannot update
+            # the inner bound and must not count as stalled)
+            period = max(1, int(opt.get("spoke_sync_period", 1)))
+            if (self._iter - self._inner_bound_update_iter
+                    >= opt["max_stalled_iters"] * period
+                    and self.BestInnerBound < math.inf):
+                global_toc("Terminating: inner bound stalled", True)
+                return True
         return False
 
     def is_converged(self) -> bool:
@@ -139,14 +144,25 @@ class PHHub(Hub):
 
     def sync(self):
         """One hub<->spoke exchange: harvest the spokes' previous async
-        results, then launch their next round on a fresh snapshot."""
+        results, then launch their next round on a fresh snapshot.
+
+        options['spoke_sync_period'] = k exchanges with the spokes only
+        every k-th sync: their device work launched at the previous
+        exchange keeps running across the intervening hub iterations
+        (XLA async dispatch), which is exactly the reference's
+        slower-cylinder overlap (ref:hub.py write-id freshness checks —
+        a spoke that hasn't produced a new result simply isn't read)."""
         self._iter += 1
-        self._harvest_all()
+        period = max(1, int(self.options.get("spoke_sync_period", 1)))
+        do_spokes = (self._iter <= 2) or (self._iter % period == 0)
+        if do_spokes:
+            self._harvest_all()
         self._fold_own_bounds()
         payload = self._snapshot()
         self.from_hub.put(payload)  # for API parity / inspection
-        for sp in self.spokes:
-            sp.update(payload)
+        if do_spokes:
+            for sp in self.spokes:
+                sp.update(payload)
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
         import time as _time

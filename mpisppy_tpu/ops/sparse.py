@@ -145,34 +145,61 @@ def ell_from_scipy(A, dtype=jnp.float32) -> EllMatrix:
 
 
 def ell_from_scipy_batch(mats, dtype=jnp.float32) -> EllMatrix:
-    """Batched EllMatrix from scipy matrices sharing one sparsity
-    pattern (vals get a leading scenario axis; cols are shared).
+    """Batched EllMatrix from scipy matrices (vals get a leading
+    scenario axis; cols are shared).
 
-    Collapses to a SHARED (unbatched) EllMatrix when all values are
-    equal too — mirroring the dense stack()'s value-equality fallback so
-    rebuilt-per-scenario deterministic matrices don't duplicate S-fold.
-    Vectorized fill: one (nnz,) -> (m, k) slot map shared by the batch,
-    so construction is O(S * nnz) numpy work, no per-row Python loop."""
+    Scenario matrices with DIFFERING sparsity patterns are padded onto
+    the pattern UNION (absent entries hold value 0) — the heterogeneous-
+    region case of the admm wrappers; matrices sharing a pattern skip
+    the union work.  Collapses to a SHARED (unbatched) EllMatrix when
+    all values are equal too — mirroring the dense stack()'s
+    value-equality fallback so rebuilt-per-scenario deterministic
+    matrices don't duplicate S-fold.  Vectorized fill: one
+    (nnz,) -> (m, k) slot map shared by the batch, no per-row loop."""
     import scipy.sparse as sps
-    first = sps.csr_matrix(mats[0])
-    first.sort_indices()
-    m, n = first.shape
-    slot_row, slot_pos, k = _slot_map(first)
-    cols = np.zeros((m, k), np.int32)
-    cols[slot_row, slot_pos] = first.indices
-
-    data = np.empty((len(mats), first.nnz))
-    data[0] = first.data
-    for s, M in enumerate(mats[1:], start=1):
+    csrs = []
+    for M in mats:
         csr = sps.csr_matrix(M)
         csr.sort_indices()
-        if not (np.array_equal(csr.indptr, first.indptr)
-                and np.array_equal(csr.indices, first.indices)):
+        csrs.append(csr)
+    first = csrs[0]
+    m, n = first.shape
+    for s, c in enumerate(csrs[1:], start=1):
+        if c.shape != (m, n):
             raise ValueError(
-                f"scenario {s}: sparsity pattern differs from scenario 0 "
-                "(batched ELL needs a shared pattern; densify or pad the "
-                "pattern union on the host first)")
-        data[s] = csr.data
+                f"scenario {s}: matrix shape {c.shape} differs from "
+                f"scenario 0's {(m, n)} (a batch shares one row/column "
+                "layout; pad on the host first)")
+    shared_pattern = all(
+        np.array_equal(c.indptr, first.indptr)
+        and np.array_equal(c.indices, first.indices) for c in csrs[1:])
+    if not shared_pattern:
+        # pattern union: mark every position present anywhere, then
+        # read each scenario's values at the union coordinates
+        pat = sps.csr_matrix(
+            (np.ones_like(first.data), first.indices, first.indptr),
+            shape=(m, n))
+        for c in csrs[1:]:
+            pat = pat + sps.csr_matrix(
+                (np.ones_like(c.data), c.indices, c.indptr), shape=(m, n))
+        pat = sps.csr_matrix(pat)
+        pat.sort_indices()
+        pat.data[:] = 1.0
+        urows = np.repeat(np.arange(m), np.diff(pat.indptr))
+        ucols = pat.indices
+        data = np.empty((len(csrs), pat.nnz))
+        for s, c in enumerate(csrs):
+            data[s] = np.asarray(c[urows, ucols]).reshape(-1)
+        slot_row, slot_pos, k = _slot_map(pat)
+        cols = np.zeros((m, k), np.int32)
+        cols[slot_row, slot_pos] = pat.indices
+    else:
+        slot_row, slot_pos, k = _slot_map(first)
+        cols = np.zeros((m, k), np.int32)
+        cols[slot_row, slot_pos] = first.indices
+        data = np.empty((len(csrs), first.nnz))
+        for s, csr in enumerate(csrs):
+            data[s] = csr.data
 
     if (data[1:] == data[0]).all():
         vals = np.zeros((m, k))

@@ -1,0 +1,93 @@
+# Model-zoo tail: battery (ref:examples/battery/battery.py) and distr
+# (ref:examples/distr/) — both oracle-tested against scipy.
+import numpy as np
+import jax.numpy as jnp
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import battery, distr
+from mpisppy_tpu.ops import pdhg
+
+
+def _spec_lp_oracle(sp, fix=None):
+    from scipy.optimize import linprog
+    A = sp.A.toarray() if hasattr(sp.A, "toarray") else np.asarray(sp.A)
+    l, u = sp.l.copy(), sp.u.copy()  # noqa: E741
+    if fix is not None:
+        l[sp.nonant_idx] = fix
+        u[sp.nonant_idx] = fix
+    A_ub, b_ub, A_eq, b_eq = [], [], [], []
+    for i in range(A.shape[0]):
+        if sp.bl[i] == sp.bu[i]:
+            A_eq.append(A[i]); b_eq.append(sp.bu[i])
+            continue
+        if np.isfinite(sp.bu[i]):
+            A_ub.append(A[i]); b_ub.append(sp.bu[i])
+        if np.isfinite(sp.bl[i]):
+            A_ub.append(-A[i]); b_ub.append(-sp.bl[i])
+    res = linprog(sp.c, A_ub=np.array(A_ub) if A_ub else None,
+                  b_ub=np.array(b_ub) if b_ub else None,
+                  A_eq=np.array(A_eq) if A_eq else None,
+                  b_eq=np.array(b_eq) if b_eq else None,
+                  bounds=list(zip(l, u)), method="highs")
+    assert res.success, res.message
+    return res.fun
+
+
+def test_battery_scenarios_match_scipy():
+    data = battery.getData(num_scens=6, seed=3)
+    names = battery.scenario_names_creator(6)
+    specs = [battery.scenario_creator(nm, data=data, use_LP=True, lam=50.0)
+             for nm in names]
+    b = batch_mod.from_specs(specs)
+    st = pdhg.solve(b.qp, pdhg.PDHGOptions(tol=1e-7, max_iters=200_000))
+    ours = np.asarray(b.objective(st.x))
+    ref = np.array([_spec_lp_oracle(sp) for sp in specs])
+    assert np.allclose(ours, ref, rtol=2e-3, atol=1e-3), (ours, ref)
+
+
+def test_battery_ph_runs_and_bounds():
+    from mpisppy_tpu.algos import ph as ph_mod
+    data = battery.getData(num_scens=6, seed=3)
+    names = battery.scenario_names_creator(6)
+    specs = [battery.scenario_creator(nm, data=data, use_LP=True, lam=50.0)
+             for nm in names]
+    b = batch_mod.from_specs(specs)
+    drv = ph_mod.PH(ph_mod.PHOptions(max_iterations=40, default_rho=0.05),
+                    b)
+    conv, eobj, tb = drv.ph_main()
+    # wait-and-see <= optimal; converged PH objective above it
+    assert tb <= eobj + 1e-2 * (1 + abs(eobj))
+    assert conv < 10.0
+
+
+def test_battery_z_binary_flagged():
+    sp = battery.scenario_creator("scen0", num_scens=4, use_LP=False)
+    assert sp.integer.sum() == 1  # exactly z
+    sp_lp = battery.scenario_creator("scen0", num_scens=4, use_LP=True)
+    assert sp_lp.integer.sum() == 0
+
+
+def test_distr_admm_matches_global_lp():
+    """Consensus ADMM over regions reproduces the merged-network LP
+    (ref:examples/distr/globalmodel.py comparison)."""
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.utils.admmWrapper import AdmmWrapper
+
+    R = 3
+    data = distr.region_data(R, seed=1)
+    names = distr.scenario_names_creator(R)
+    cons = distr.consensus_vars_creator(R, data)
+    wrapper = AdmmWrapper({}, names,
+                          lambda nm, **kw: distr.scenario_creator(
+                              nm, data=data),
+                          cons)
+    b = wrapper.make_batch()
+    # admm rho tuning matters: rho>=5 freezes the inter-region flows at
+    # a consensus point ~1-7% off optimal (measured); rho~2 is exact
+    drv = ph_mod.PH(ph_mod.PHOptions(max_iterations=600, default_rho=2.0,
+                                     conv_thresh=1e-7,
+                                     subproblem_windows=10), b)
+    conv, eobj, tb = drv.ph_main()
+    ref = distr.global_lp_oracle(data)
+    assert conv <= 1e-3, conv
+    assert abs(eobj - ref) <= 5e-3 * (1 + abs(ref)), (eobj, ref)

@@ -113,3 +113,44 @@ def test_solution_writers(tmp_path):
     d = tmp_path / "tree"
     ws.write_tree_solution(str(d))
     assert (d / "ROOT.csv").exists()
+
+
+def test_spoke_sync_period():
+    """spoke_sync_period=k exchanges with spokes every k-th sync; bounds
+    still land and the gap still closes (the async-cylinder overlap
+    analog, ref:mpisppy/cylinders/hub.py write-id freshness)."""
+    import numpy as np
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.cylinders.spoke import (
+        LagrangianOuterBound, XhatXbarInnerBound,
+    )
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    hub = {
+        "hub_class": hub_mod.PHHub,
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_mod.PHOptions(max_iterations=30,
+                                                   default_rho=1.0,
+                                                   conv_thresh=0.0),
+                       "batch": batch},
+        "hub_kwargs": {"options": {"rel_gap": 0.01,
+                                   "spoke_sync_period": 3}},
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound,
+         "opt_kwargs": {"options": {}}},
+        {"spoke_class": XhatXbarInnerBound,
+         "opt_kwargs": {"options": {}}},
+    ]
+    wheel = WheelSpinner(hub, spokes)
+    wheel.spin()
+    assert np.isfinite(wheel.BestOuterBound)
+    assert np.isfinite(wheel.BestInnerBound)
+    _, rel_gap = wheel.spcomm.compute_gaps()
+    assert rel_gap <= 0.05, rel_gap

@@ -13,6 +13,18 @@ from mpisppy_tpu.ops import bnb, boxqp, pdhg
 from mpisppy_tpu.ops.bnb import BnBOptions
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_caches():
+    """The branch-and-bound tests compile many large programs; run with
+    a fresh XLA cache so cumulative compile-cache pressure from the rest
+    of the suite cannot push the CPU client into native OOM (a segfault
+    in this module reproduced only in full-suite runs)."""
+    import jax
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
 def milp_oracle(c, A, bl, bu, l, u, integer):  # noqa: E741
     from scipy.optimize import Bounds, LinearConstraint, milp
     res = milp(c, constraints=LinearConstraint(A, bl, bu),

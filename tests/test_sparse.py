@@ -60,11 +60,16 @@ def test_ell_batched_vals():
                                atol=1e-6)
 
 
-def test_ell_pattern_mismatch_raises():
+def test_ell_pattern_mismatch_unions():
+    """Differing sparsity patterns are padded onto the pattern union
+    (heterogeneous admm regions); values match the dense stack."""
     a = sps.csr_matrix(np.array([[1.0, 0.0], [0.0, 2.0]]))
     b = sps.csr_matrix(np.array([[0.0, 1.0], [0.0, 2.0]]))
-    with pytest.raises(ValueError, match="pattern"):
-        ell_from_scipy_batch([a, b])
+    ell = ell_from_scipy_batch([a, b])
+    dense = np.asarray(ell.toarray())
+    assert dense.shape == (2, 2, 2)
+    assert np.allclose(dense[0], a.toarray())
+    assert np.allclose(dense[1], b.toarray())
 
 
 def test_ell_norms_match_dense():
