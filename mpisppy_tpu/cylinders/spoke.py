@@ -105,10 +105,16 @@ class InnerBoundSpoke(Spoke):
             self.bound = value
             self.best_xhat = np.asarray(xhat)
 
+    def _finalize(self, res, xhat):
+        """Hook applied at HARVEST (blocking is fine here): subclasses
+        run the stalled-tail rescue so Spoke.update stays async."""
+        return res
+
     def harvest(self):
         if self._pending is None:
             return None
         res, xhat = self._pending
+        res = self._finalize(res, xhat)
         if bool(res.feasible):
             self._offer(float(res.value), xhat)
         return self.bound
@@ -241,9 +247,16 @@ class XhatXbarInnerBound(InnerBoundSpoke):
             qp = self.batch.with_fixed_nonants(cand)
             self._solver = pdhg.init_state(
                 qp, _dc.replace(self.pdhg_opts, detect_infeas=True))
-        res, self._solver = xhat_mod.evaluate_warm(
+        # async core solve only; the stalled-tail rescue happens in
+        # _finalize at harvest so update never blocks on device results
+        res, self._solver = xhat_mod._evaluate_warm_core(
             self.batch, cand, self._solver, self.pdhg_opts)
         self._pending = (res, cand)
+
+    def _finalize(self, res, xhat):
+        import jax.numpy as jnp
+        return xhat_mod._rescue_merge(self.batch, jnp.asarray(xhat), res,
+                                      self.pdhg_opts, 1e-3)
 
 
 class XhatShuffleInnerBound(InnerBoundSpoke):
@@ -350,8 +363,13 @@ class _SlamHeuristic(InnerBoundSpoke):
     def update(self, hub_payload):
         x_non = hub_payload["nonants"]
         xhat = xhat_mod.slam_candidate(self.batch, x_non, self.sense_max)
-        self._pending = (xhat_mod.evaluate(self.batch, xhat, self.pdhg_opts),
-                         xhat)
+        self._pending = (
+            xhat_mod._evaluate_core(self.batch, xhat, self.pdhg_opts),
+            xhat)
+
+    def _finalize(self, res, xhat):
+        return xhat_mod._rescue_merge(self.batch, xhat, res,
+                                      self.pdhg_opts, 1e-3)
 
 
 class SlamMaxHeuristic(_SlamHeuristic):
