@@ -172,9 +172,10 @@ def bench_sslp_gap():
     return out
 
 
-def bench_sweep():
-    """PH iters/sec across the scenario sweep (continuity with the
-    round-2 headline metric)."""
+def bench_sweep_one(S):
+    """PH iters/sec at one scenario count (continuity with the round-2
+    headline metric); each scale runs as its OWN subprocess phase so a
+    worker crash at 100k cannot cost the smaller scales their numbers."""
     import jax
     import jax.numpy as jnp
 
@@ -182,29 +183,36 @@ def bench_sweep():
     from mpisppy_tpu.ops import pdhg
 
     results = []
-    for S in SWEEP:
-        batch, _ = _sslp_batch(S)
-        opts = ph_mod.PHOptions(
-            default_rho=20.0, subproblem_windows=8,
-            pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
-        rho = jnp.full((batch.num_nonants,), opts.default_rho)
-        state, _, _ = ph_mod.ph_iter0(batch, rho, opts)
-        state = ph_mod.ph_iterk(batch, state, opts)   # compile
-        jax.block_until_ready(state.conv)
-        n_iters = 5 if S >= 100_000 else 20
-        t0 = time.perf_counter()
-        for _ in range(n_iters):
-            state = ph_mod.ph_iterk(batch, state, opts)
-        jax.block_until_ready(state.conv)
-        dt = time.perf_counter() - t0
-        ips = n_iters / dt
-        flops = _flops_per_ph_iter(batch, opts) * ips
-        results.append({
-            "scenarios": S,
-            "iters_per_sec": round(ips, 3),
-            "achieved_tflops_est": round(flops / 1e12, 3),
-        })
-    return results
+    for S in [S]:
+        try:
+            batch, _ = _sslp_batch(S)
+            # keep every dispatch SHORT at 100k scale: a single
+            # 400-window iter0 (~17.6k PDHG iterations in one
+            # while_loop) can outlive the TPU worker's patience
+            opts = ph_mod.PHOptions(
+                default_rho=20.0, subproblem_windows=8,
+                iter0_windows=80 if S >= 100_000 else 400,
+                pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+            rho = jnp.full((batch.num_nonants,), opts.default_rho)
+            state, _, _ = ph_mod.ph_iter0(batch, rho, opts)
+            state = ph_mod.ph_iterk(batch, state, opts)   # compile
+            jax.block_until_ready(state.conv)
+            n_iters = 5 if S >= 100_000 else 20
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                state = ph_mod.ph_iterk(batch, state, opts)
+            jax.block_until_ready(state.conv)
+            dt = time.perf_counter() - t0
+            ips = n_iters / dt
+            flops = _flops_per_ph_iter(batch, opts) * ips
+            results.append({
+                "scenarios": S,
+                "iters_per_sec": round(ips, 3),
+                "achieved_tflops_est": round(flops / 1e12, 3),
+            })
+        except Exception as e:
+            results.append({"scenarios": S, "error": repr(e)})
+    return results[0]
 
 
 def bench_wheel_overhead():
@@ -288,7 +296,7 @@ def bench_uc_fwph():
              for nm in names]
     batch = batch_mod.from_specs(specs)
     ph_opts = ph_mod.PHOptions(
-        default_rho=200.0, max_iterations=min(MAX_WHEEL_ITERS, 150),
+        default_rho=200.0, max_iterations=MAX_WHEEL_ITERS,
         conv_thresh=0.0,
         subproblem_windows=10,
         pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
@@ -313,10 +321,11 @@ def bench_uc_fwph():
 
 _PHASES = {
     "sslp_to_1pct_gap": lambda: bench_sslp_gap(),
-    "sweep_iters_per_sec": lambda: bench_sweep(),
     "uc_fwph_to_1pct_gap": lambda: bench_uc_fwph(),
     "wheel_overhead": lambda: bench_wheel_overhead(),
 }
+for _S in SWEEP:
+    _PHASES[f"sweep_{_S}"] = (lambda S=_S: bench_sweep_one(S))
 
 
 def _run_phase_subprocess(phase: str, timeout: int = 2400):
@@ -358,12 +367,15 @@ def main():
     detail = {}
     for phase in _PHASES:
         detail[phase] = _run_phase_subprocess(phase)
+    detail["sweep_iters_per_sec"] = [
+        detail.pop(f"sweep_{S}") for S in SWEEP]
     detail["bench_total_sec"] = round(time.time() - t_start, 1)
     import jax
     detail["device"] = str(jax.devices()[0].device_kind)
 
-    with open("BENCH_DETAIL.json", "w") as f:
-        json.dump(detail, f, indent=1)
+    if not SMOKE:  # never clobber the hardware artifact with smoke runs
+        with open("BENCH_DETAIL.json", "w") as f:
+            json.dump(detail, f, indent=1)
 
     headline = detail["sslp_to_1pct_gap"]
     if "seconds_to_gap" in headline:

@@ -138,9 +138,13 @@ def _rescue_merge(batch: ScenarioBatch, xhat: Array, res: XhatResult,
             max_iters=min(mul * opts.max_iters, 60_000))
         r2 = _evaluate_core(batch, xhat, rescue, feas_tol)
         ok2 = _scen_ok(r2, feas_tol)
-        per = jnp.where(ok, per, r2.per_scenario)
-        rp = jnp.where(ok, rp, r2.primal_resid)
-        status = jnp.where(ok, status, r2.status)
+        # adopt the rescue's result ONLY where it actually converged —
+        # a certified-INFEASIBLE status or a near-miss residual must not
+        # be clobbered by a tier that diverged for that scenario
+        newly = ~ok & ok2
+        per = jnp.where(newly, r2.per_scenario, per)
+        rp = jnp.where(newly, r2.primal_resid, rp)
+        status = jnp.where(newly, r2.status, status)
         ok = ok | ok2
         if bool(jnp.all(jnp.where(real, ok, True))):
             break

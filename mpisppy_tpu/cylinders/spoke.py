@@ -254,7 +254,6 @@ class XhatXbarInnerBound(InnerBoundSpoke):
         self._pending = (res, cand)
 
     def _finalize(self, res, xhat):
-        import jax.numpy as jnp
         return xhat_mod._rescue_merge(self.batch, jnp.asarray(xhat), res,
                                       self.pdhg_opts, 1e-3)
 
@@ -303,6 +302,17 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
         if feas.any():
             j = int(np.argmin(np.where(feas, vals, np.inf)))
             self._offer(float(vals[j]), np.asarray(cands)[j])
+        else:
+            # every candidate failed the batched core evaluation — at
+            # scale that is usually the stalled-tail artifact, not true
+            # infeasibility; rescue-evaluate the best candidate (host
+            # level: blocking is fine at harvest)
+            j = int(np.argmin(vals))
+            res = xhat_mod.evaluate(self.batch,
+                                    jnp.asarray(np.asarray(cands)[j]),
+                                    self.pdhg_opts)
+            if bool(res.feasible):
+                self._offer(float(res.value), np.asarray(cands)[j])
         return self.bound
 
 
