@@ -182,37 +182,34 @@ def bench_sweep_one(S):
     from mpisppy_tpu.algos import ph as ph_mod
     from mpisppy_tpu.ops import pdhg
 
-    results = []
-    for S in [S]:
-        try:
-            batch, _ = _sslp_batch(S)
-            # keep every dispatch SHORT at 100k scale: a single
-            # 400-window iter0 (~17.6k PDHG iterations in one
-            # while_loop) can outlive the TPU worker's patience
-            opts = ph_mod.PHOptions(
-                default_rho=20.0, subproblem_windows=8,
-                iter0_windows=80 if S >= 100_000 else 400,
-                pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
-            rho = jnp.full((batch.num_nonants,), opts.default_rho)
-            state, _, _ = ph_mod.ph_iter0(batch, rho, opts)
-            state = ph_mod.ph_iterk(batch, state, opts)   # compile
-            jax.block_until_ready(state.conv)
-            n_iters = 5 if S >= 100_000 else 20
-            t0 = time.perf_counter()
-            for _ in range(n_iters):
-                state = ph_mod.ph_iterk(batch, state, opts)
-            jax.block_until_ready(state.conv)
-            dt = time.perf_counter() - t0
-            ips = n_iters / dt
-            flops = _flops_per_ph_iter(batch, opts) * ips
-            results.append({
-                "scenarios": S,
-                "iters_per_sec": round(ips, 3),
-                "achieved_tflops_est": round(flops / 1e12, 3),
-            })
-        except Exception as e:
-            results.append({"scenarios": S, "error": repr(e)})
-    return results[0]
+    try:
+        batch, _ = _sslp_batch(S)
+        # keep every dispatch SHORT at 100k scale: a single 400-window
+        # iter0 (~17.6k PDHG iterations in one while_loop) can outlive
+        # the TPU worker's patience
+        opts = ph_mod.PHOptions(
+            default_rho=20.0, subproblem_windows=8,
+            iter0_windows=80 if S >= 100_000 else 400,
+            pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+        rho = jnp.full((batch.num_nonants,), opts.default_rho)
+        state, _, _ = ph_mod.ph_iter0(batch, rho, opts)
+        state = ph_mod.ph_iterk(batch, state, opts)   # compile
+        jax.block_until_ready(state.conv)
+        n_iters = 5 if S >= 100_000 else 20
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            state = ph_mod.ph_iterk(batch, state, opts)
+        jax.block_until_ready(state.conv)
+        dt = time.perf_counter() - t0
+        ips = n_iters / dt
+        flops = _flops_per_ph_iter(batch, opts) * ips
+        return {
+            "scenarios": S,
+            "iters_per_sec": round(ips, 3),
+            "achieved_tflops_est": round(flops / 1e12, 3),
+        }
+    except Exception as e:
+        return {"scenarios": S, "error": repr(e)}
 
 
 def bench_wheel_overhead():

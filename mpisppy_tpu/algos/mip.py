@@ -340,17 +340,57 @@ def certified_mip_gap(batch: ScenarioBatch, ph_options=None,
     S = batch.num_real
     for s in range(min(n_shuffle, S)):
         cands.append(xhat_mod.round_integers(batch, x_non[s]))
+    # wait-and-see INTEGER candidates: a few scenarios' own exact-MIP
+    # first stages (one cheap batched B&B on a SLICE of the plain
+    # batch).  At a converged PH the shuffle candidates above all equal
+    # the consensus point, whose integer-recourse value can be far off —
+    # the WS solutions are the diverse, integral pool the reference's
+    # shuffle looper effectively draws from (it solves subproblems as
+    # MIPs).
+    k_ws = min(S, 8)
+
+    def _head(x, batched_ndim):
+        if hasattr(x, "vals"):  # EllMatrix
+            return dataclasses.replace(x, vals=_head(x.vals, batched_ndim))
+        return x[:k_ws] if getattr(x, "ndim", 0) == batched_ndim else x
+
+    qp_ws = dataclasses.replace(
+        batch.qp, c=batch.qp.c[:k_ws], q=batch.qp.q[:k_ws],
+        A=_head(batch.qp.A, 3),
+        bl=_head(batch.qp.bl, 2), bu=_head(batch.qp.bu, 2),
+        l=_head(batch.qp.l, 2), u=_head(batch.qp.u, 2))
+    ws = bnb.solve_mip(qp_ws, _head(batch.d_col, 2), _int_cols(batch),
+                       opts)
+    ws_x = np.asarray(ws.x)[:, np.asarray(batch.nonant_idx)]
+    ws_feas = np.asarray(ws.feasible)
+    int_slot = np.asarray(batch.integer_slot)
+    seen_keys = set()
+    for s in range(k_ws):
+        if not ws_feas[s]:
+            continue
+        # round only the INTEGER slots; continuous first-stage
+        # coordinates keep the scenario's exact values
+        cand = np.where(int_slot, np.round(ws_x[s]), ws_x[s])
+        key = tuple(np.round(cand[int_slot]).astype(int))
+        if key in seen_keys:
+            continue
+        seen_keys.add(key)
+        cands.append(jnp.asarray(cand, batch.qp.c.dtype))
     lp_vals = [float(xhat_mod.evaluate(batch, c, opts.lp).value)
                for c in cands]
     order = np.argsort(lp_vals)
 
-    # -- certified inner: MIP-evaluate candidates until one is feasible ---
+    # -- certified inner: MIP-evaluate candidates in LP rank order; try
+    #    a few past the first success (LP rank is a good but imperfect
+    #    predictor of the integer-recourse value) -----------------------
     inner, xhat_best = float("inf"), np.asarray(cands[int(order[0])])
+    n_eval = 0
     for i in order:
         ev = evaluate_mip(batch, cands[int(i)], opts)
+        n_eval += 1
         if ev["feasible"] and ev["value"] < inner:
             inner, xhat_best = ev["value"], ev["xhat"]
-        if np.isfinite(inner):
+        if np.isfinite(inner) and n_eval >= 3:
             break
 
     # -- certified outer ---------------------------------------------------

@@ -305,14 +305,17 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
         else:
             # every candidate failed the batched core evaluation — at
             # scale that is usually the stalled-tail artifact, not true
-            # infeasibility; rescue-evaluate the best candidate (host
-            # level: blocking is fine at harvest)
-            j = int(np.argmin(vals))
-            res = xhat_mod.evaluate(self.batch,
-                                    jnp.asarray(np.asarray(cands)[j]),
-                                    self.pdhg_opts)
-            if bool(res.feasible):
-                self._offer(float(res.value), np.asarray(cands)[j])
+            # infeasibility (all `vals` are +inf, so there is no rank to
+            # pick by); rescue-evaluate candidates in order until one
+            # lands, capped at 2 per sync (host level: blocking is fine
+            # at harvest)
+            for j in range(min(2, len(vals))):
+                res = xhat_mod.evaluate(self.batch,
+                                        jnp.asarray(np.asarray(cands)[j]),
+                                        self.pdhg_opts)
+                if bool(res.feasible):
+                    self._offer(float(res.value), np.asarray(cands)[j])
+                    break
         return self.bound
 
 
