@@ -1,0 +1,324 @@
+###############################################################################
+# Fused hub-and-spoke wheel step.
+#
+# The reference runs hub and spokes CONCURRENTLY on disjoint MPI ranks
+# (ref:mpisppy/spin_the_wheel.py:224-242 _make_comms;
+# ref:mpisppy/cylinders/hub.py:379-445 RMA windows), so spoke wall-clock
+# is nearly free.  On one TPU chip every cylinder shares a single device
+# queue — separate dispatches SERIALIZE, and a to-convergence Lagrangian
+# or xhat solve per sync costs hundreds of times the hub iteration it
+# decorates (measured 642x in round 3, BENCH_DETAIL.json).
+#
+# The TPU-native answer is fusion, not concurrency: the Lagrangian bound
+# is the SAME subproblem kernel with W frozen and no prox, and the xhat
+# recourse evaluation is the SAME kernel with the nonant box collapsed —
+# so both ride inside the hub's single jitted step as fixed small
+# restart-window budgets with WARM state carried across iterations.
+# Per-iteration device cost becomes
+#     (subproblem_windows + lag_windows + xhat_windows) restart windows
+# ~ 2-3x bare PH, while the warm states converge across iterations just
+# like the reference's continuously-running spoke processes.  Bounds are
+# still gated by the same certificates as the standalone spokes
+# (dual-residual for the Lagrangian, primal-residual feasibility for
+# xhat), so nothing uncertified ever enters the gap.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.algos import lagrangian as lag_mod
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.algos import xhat as xhat_mod
+from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.ops import boxqp, pdhg
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedWheelOptions:
+    """Static per-iteration budgets for the fused spoke plane.
+
+    A window is `restart_period` PDHG iterations; the defaults add
+    ~2x the hub's own subproblem work per iteration.  The xhat profile
+    uses omega0=0.1 / restart_period=80: the stalled-tail cure measured
+    in round 3 (algos/xhat._RESCUE_TIERS) applied from the start, so the
+    in-loop evaluation rarely needs a blocking rescue."""
+
+    lag_windows: int = 8
+    xhat_windows: int = 4
+    slam_windows: int = 0        # 0 = slam plane disabled
+    slam_sense_max: bool = True  # ref slam_heuristic max/min variants
+    shuffle_windows: int = 0     # 0 = shuffle plane disabled
+    # run the spoke planes only every spoke_period-th iteration (two
+    # compiled variants, host-alternated) — the fused analog of the
+    # hub's spoke_sync_period: bound freshness lags at most
+    # spoke_period iterations, per-iteration cost amortizes by 1/p
+    spoke_period: int = 1
+    lag_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
+        tol=1e-6, restart_period=40)
+    xhat_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
+        tol=1e-6, omega0=0.1, restart_period=80)
+    xhat_feas_tol: float = 1e-3
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["ph", "lag_solver", "lag_bound", "lag_certified",
+                 "xhat_solver", "xhat_cand", "xhat_value", "xhat_feasible",
+                 "slam_solver", "slam_cand", "slam_value", "slam_feasible",
+                 "shuf_solver", "shuf_cand", "shuf_value", "shuf_feasible",
+                 "scalars"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class FusedWheelState:
+    ph: ph_mod.PHState
+    lag_solver: pdhg.PDHGState   # warm iterates for L(W)
+    lag_bound: Array             # () latest E[dual] at W
+    lag_certified: Array         # () bool: dual residuals cleared tol
+    xhat_solver: pdhg.PDHGState  # warm iterates for the recourse eval
+    xhat_cand: Array             # (num_nodes, N) candidate evaluated
+    xhat_value: Array            # () E[f(xhat)]; +inf unless feasible
+    xhat_feasible: Array         # () bool
+    slam_solver: pdhg.PDHGState  # warm iterates for the slam candidate
+    slam_cand: Array             # (N,) slammed candidate
+    slam_value: Array            # ()
+    slam_feasible: Array         # () bool
+    shuf_solver: pdhg.PDHGState  # warm iterates for the shuffle candidate
+    shuf_cand: Array             # (N,) candidate (one scenario's nonants)
+    shuf_value: Array            # ()
+    shuf_feasible: Array         # () bool
+    # (9,) f32 [conv, lag_bound, lag_cert, xhat_value, xhat_feas,
+    # slam_value, slam_feas, shuf_value, shuf_feas]: every per-iteration
+    # host decision packed into ONE device array so the hub pays ONE
+    # device->host transfer per iteration (the axon tunnel charges a
+    # full round trip per scalar read — ~10 reads/iter measurably
+    # dominated wall-clock at small scale)
+    scalars: Array
+
+
+def _lag_step(batch: ScenarioBatch, W: Array, solver: pdhg.PDHGState,
+              wopts: FusedWheelOptions):
+    """Advance the Lagrangian solve a fixed budget and certify the bound
+    (same math as algos.lagrangian.lagrangian_bound, truncated)."""
+    qp = lag_mod._lagrangian_qp(batch, W)
+    st = pdhg.solve_fixed(qp, wopts.lag_windows, wopts.lag_pdhg, solver)
+    dual = boxqp.dual_objective(qp, st.x, st.y)
+    _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    tol = jnp.maximum(wopts.lag_pdhg.tol,
+                      5.0 * jnp.finfo(st.x.dtype).eps)
+    real = batch.p > 0.0
+    certified = jnp.all(jnp.where(real, rd <= 10.0 * tol, True))
+    return st, batch.expectation(dual), certified
+
+
+def _eval_step(batch: ScenarioBatch, cand: Array,
+               solver: pdhg.PDHGState, windows: int,
+               wopts: FusedWheelOptions):
+    """Advance the recourse evaluation of a fixed candidate a fixed
+    budget.  The candidate moves every iteration, but consecutive
+    candidates differ little, so the warm iterates (clipped into the new
+    fixed box) track it — the fused analog of XhatXbarInnerBound's warm
+    PDHG state.  Validity: the value only counts when EVERY real
+    scenario's primal residual clears feas_tol, so a truncated or
+    genuinely infeasible solve can never produce an incumbent."""
+    qp = batch.with_fixed_nonants(cand)
+    st = dataclasses.replace(solver, x=jnp.clip(solver.x, qp.l, qp.u))
+    st = pdhg.solve_fixed(qp, windows, wopts.xhat_pdhg, st)
+    obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
+    rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    real = batch.p > 0.0
+    ok = rp <= wopts.xhat_feas_tol
+    feas = jnp.all(jnp.where(real, ok, True))
+    value = jnp.where(feas, batch.expectation(obj),
+                      jnp.asarray(jnp.inf, obj.dtype))
+    return st, value, feas
+
+
+@partial(jax.jit, static_argnames=("opts", "wopts"))
+def fused_iter0(batch: ScenarioBatch, rho: Array, opts: ph_mod.PHOptions,
+                wopts: FusedWheelOptions):
+    """PH Iter0 plus spoke-plane state init.  Both spoke solvers warm
+    from the iter0 iterates (same A, so Lnorm/omega carry) — no extra
+    power iterations, no cold starts."""
+    phst, tb, cert = ph_mod.ph_iter0(batch, rho, opts)
+    solver = phst.solver
+    dt = batch.qp.c.dtype
+    xhat_solver = dataclasses.replace(
+        solver, omega=jnp.full_like(solver.omega, wopts.xhat_pdhg.omega0))
+    st = FusedWheelState(
+        ph=phst,
+        lag_solver=solver,
+        lag_bound=jnp.asarray(-jnp.inf, dt),
+        lag_certified=jnp.asarray(False),
+        xhat_solver=xhat_solver,
+        xhat_cand=jnp.zeros((batch.tree.num_nodes, batch.num_nonants), dt),
+        xhat_value=jnp.asarray(jnp.inf, dt),
+        xhat_feasible=jnp.asarray(False),
+        slam_solver=xhat_solver,
+        slam_cand=jnp.zeros((batch.num_nonants,), dt),
+        slam_value=jnp.asarray(jnp.inf, dt),
+        slam_feasible=jnp.asarray(False),
+        shuf_solver=xhat_solver,
+        shuf_cand=jnp.zeros((batch.num_nonants,), dt),
+        shuf_value=jnp.asarray(jnp.inf, dt),
+        shuf_feasible=jnp.asarray(False),
+        scalars=jnp.zeros((9,), dt),
+    )
+    return dataclasses.replace(st, scalars=_pack_scalars(st)), tb, cert
+
+
+def _pack_scalars(st: "FusedWheelState") -> Array:
+    dt = st.ph.conv.dtype
+    return jnp.stack([
+        st.ph.conv.astype(dt),
+        st.lag_bound.astype(dt),
+        st.lag_certified.astype(dt),
+        st.xhat_value.astype(dt),
+        st.xhat_feasible.astype(dt),
+        st.slam_value.astype(dt),
+        st.slam_feasible.astype(dt),
+        st.shuf_value.astype(dt),
+        st.shuf_feasible.astype(dt),
+    ])
+
+
+SCALAR_KEYS = ("conv", "lag_bound", "lag_certified", "xhat_value",
+               "xhat_feasible", "slam_value", "slam_feasible",
+               "shuf_value", "shuf_feasible")
+
+
+@partial(jax.jit, static_argnames=("opts", "wopts"))
+def fused_iterk(batch: ScenarioBatch, st: FusedWheelState,
+                opts: ph_mod.PHOptions, wopts: FusedWheelOptions,
+                shuf_id: Array | None = None) -> FusedWheelState:
+    """One wheel iteration as ONE compiled program: hub PH step, then
+    the Lagrangian bound at the fresh W and the recourse values at the
+    fresh candidates (rounded x̄ / slam / shuffled scenario), each a
+    fixed warm budget."""
+    phst = ph_mod.ph_iterk(batch, st.ph, opts)
+    out = dataclasses.replace(st, ph=phst)
+    if wopts.lag_windows > 0:
+        lag_solver, lag_bound, lag_cert = _lag_step(
+            batch, phst.W, st.lag_solver, wopts)
+        out = dataclasses.replace(out, lag_solver=lag_solver,
+                                  lag_bound=lag_bound,
+                                  lag_certified=lag_cert)
+    if wopts.xhat_windows > 0:
+        cand = xhat_mod.round_integers(batch, phst.xbar_nodes)
+        xs, value, feas = _eval_step(batch, cand, st.xhat_solver,
+                                     wopts.xhat_windows, wopts)
+        out = dataclasses.replace(out, xhat_solver=xs, xhat_cand=cand,
+                                  xhat_value=value, xhat_feasible=feas)
+    if wopts.slam_windows > 0 or wopts.shuffle_windows > 0:
+        x_non = batch.nonants(phst.solver.x)
+    if wopts.slam_windows > 0:
+        scand = xhat_mod.slam_candidate(batch, x_non, wopts.slam_sense_max)
+        ss, svalue, sfeas = _eval_step(batch, scand, st.slam_solver,
+                                      wopts.slam_windows, wopts)
+        out = dataclasses.replace(out, slam_solver=ss, slam_cand=scand,
+                                  slam_value=svalue, slam_feasible=sfeas)
+    if wopts.shuffle_windows > 0:
+        # one rotating candidate per iteration (the host supplies the
+        # deterministic shuffle index, seed 42 — ref:
+        # xhatshufflelooper_bounder.py:74); over a run this visits
+        # scenarios' own first stages like the reference's looper
+        sid = jnp.asarray(0, jnp.int32) if shuf_id is None else shuf_id
+        fcand = xhat_mod.round_integers(batch, x_non[sid])
+        fs, fvalue, ffeas = _eval_step(batch, fcand, st.shuf_solver,
+                                       wopts.shuffle_windows, wopts)
+        out = dataclasses.replace(out, shuf_solver=fs, shuf_cand=fcand,
+                                  shuf_value=fvalue, shuf_feasible=ffeas)
+    return dataclasses.replace(out, scalars=_pack_scalars(out))
+
+
+class FusedPH(ph_mod.PH):
+    """PH driver whose iteration IS the whole wheel step.
+
+    Use with the Fused* spoke classes (cylinders.spoke): they read
+    bounds off `self.wstate` instead of launching their own device
+    work.  Classic spokes still work alongside (the hub updates them on
+    its sync period as before)."""
+
+    def __init__(self, options, batch, wheel_options=None, **kw):
+        super().__init__(options, batch, **kw)
+        self.wheel_options = wheel_options or FusedWheelOptions()
+        self.wstate: FusedWheelState | None = None
+        self.scalar_cache: dict | None = None
+        self.cand_cache: dict | None = None
+        self._scalars_inflight = None
+        self._shuf_order = np.random.default_rng(42).permutation(
+            batch.num_real)
+        self._shuf_cursor = 0
+
+    def _cache_scalars(self, pipelined: bool = False):
+        """ONE device->host transfer per iteration: everything the hub
+        and the fused spokes decide on.  Pipelined mode reads the
+        PREVIOUS iteration's packed scalars right after dispatching the
+        next step, so the host never blocks on the in-flight program —
+        the hub's decisions lag one iteration (bounds are valid at every
+        iterate, so a one-iteration-late termination is still certified;
+        this is exactly the reference's stale-window tolerance,
+        ref:cylinders/hub.py write-id freshness).  The candidate tensors
+        ride the same pipeline so a cached value is always paired with
+        the candidate it was evaluated at."""
+        inflight = (self.wstate.scalars, self.wstate.xhat_cand,
+                    self.wstate.slam_cand, self.wstate.shuf_cand)
+        if pipelined and self._scalars_inflight is not None:
+            scalars, xc, sc_, fc = self._scalars_inflight
+        else:
+            scalars, xc, sc_, fc = inflight
+        self._scalars_inflight = inflight
+        vals = np.asarray(scalars)
+        self.scalar_cache = dict(zip(SCALAR_KEYS, (float(v) for v in vals)))
+        # device refs, transferred only when a spoke actually offers
+        self.cand_cache = {"xhat": xc, "slam": sc_, "shuf": fc}
+
+    def flush_scalars(self):
+        """Synchronize the cache to the LATEST iterate (final harvest)."""
+        if self.wstate is not None:
+            self._cache_scalars()
+
+    def _read_conv(self) -> float:
+        return self.scalar_cache["conv"]
+
+    def state_template(self):
+        st, _, _ = jax.eval_shape(
+            partial(fused_iter0, opts=self.options,
+                    wopts=self.wheel_options),
+            self.batch, self.rho)
+        return st
+
+    def _iter0_impl(self):
+        self.wstate, tb, cert = fused_iter0(
+            self.batch, self.rho, self.options, self.wheel_options)
+        self._cache_scalars()
+        return self.wstate.ph, tb, cert
+
+    def _iterk_impl(self):
+        sid = jnp.asarray(
+            int(self._shuf_order[self._shuf_cursor]), jnp.int32)
+        self._shuf_cursor = (self._shuf_cursor + 1) % len(self._shuf_order)
+        wopts = self.wheel_options
+        p = max(1, int(wopts.spoke_period))
+        if p > 1 and (self._iter % p) != 0:
+            # hub-only variant: spoke planes skipped, their state/bounds
+            # carried untouched (harvests re-read last values — folding
+            # is idempotent)
+            wopts = dataclasses.replace(
+                wopts, lag_windows=0, xhat_windows=0, slam_windows=0,
+                shuffle_windows=0)
+        # self.state may have been rebound by extensions/convergers
+        # (e.g. rho updaters) — fold it back into the wheel state first
+        self.wstate = fused_iterk(
+            self.batch,
+            dataclasses.replace(self.wstate, ph=self.state),
+            self.options, wopts, sid)
+        self._cache_scalars(pipelined=True)
+        return self.wstate.ph

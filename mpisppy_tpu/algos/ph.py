@@ -242,6 +242,14 @@ class PH:
 
     _label = "PH"
 
+    def state_template(self):
+        """Abstract (shape/dtype) pytree of this driver's state — the
+        unflatten template for checkpoint restore (hub.load_checkpoint)
+        without paying an Iter0 solve."""
+        st, _, _ = jax.eval_shape(partial(ph_iter0, opts=self.options),
+                                  self.batch, self.rho)
+        return st
+
     # -- algorithm step hooks (overridden by APH) -------------------------
     def _iter0_impl(self):
         return ph_iter0(self.batch, self.rho, self.options)
@@ -251,6 +259,11 @@ class PH:
 
     def _iter_msg(self, k: int, conv: float) -> str:
         return f"{self._label} iter {k}: conv = {conv:.3e}"
+
+    def _read_conv(self) -> float:
+        """Per-iteration convergence read (one device scalar transfer;
+        FusedPH serves it from the packed scalar cache instead)."""
+        return float(self.state.conv)
 
     def Eobjective(self) -> float:
         return float(ph_eobjective(self.batch, self.state))
@@ -277,7 +290,7 @@ class PH:
     def iterk_loop(self):
         import time
         t0 = time.time()
-        for k in range(1, self.options.max_iterations + 1):
+        for k in range(self._iter + 1, self.options.max_iterations + 1):
             self._iter = k
             self._ext("miditer")
             # the fused step solves + recomputes xbar/W in one program,
@@ -286,7 +299,7 @@ class PH:
             self._ext("pre_solve_loop")
             self.state = self._iterk_impl()
             self._ext("post_solve_loop")
-            conv = float(self.state.conv)
+            conv = self._read_conv()
             self._ext("enditer")
             if self.spcomm is not None:
                 self.spcomm.sync()
@@ -315,8 +328,17 @@ class PH:
         return self.Eobjective()
 
     def ph_main(self):
-        """Returns (conv, Eobj, trivial_bound) (ref:opt/ph.py:31-76)."""
-        tb = self.Iter0()
+        """Returns (conv, Eobj, trivial_bound) (ref:opt/ph.py:31-76).
+
+        Resume: when state was preloaded (checkpoint restore — see
+        utils.wxbarutils.load_ph_state and the hub's checkpoint hooks),
+        Iter0 is skipped and the loop continues from the restored
+        iteration counter — the analog of the reference's
+        solve-retry/restart semantics (ref:mpisppy/spopt.py:931-960)."""
+        if self.state is None:
+            tb = self.Iter0()
+        else:
+            tb = self.trivial_bound
         conv = self.iterk_loop()
         eobj = self.post_loops()
         return conv, eobj, tb

@@ -119,9 +119,9 @@ class PHHub(Hub):
             "bounds": (self.BestOuterBound, self.BestInnerBound),
         }
 
-    def _harvest_all(self):
+    def _harvest_all(self, only=None):
         """Fold every spoke's latest result into the bound bookkeeping."""
-        for sp in self.spokes:
+        for sp in (self.spokes if only is None else only):
             b = sp.harvest()
             if b is None:
                 continue
@@ -140,7 +140,7 @@ class PHHub(Hub):
         the trivial bound enters via is_converged)."""
 
     def _trace_extra(self) -> dict:
-        return {"conv": float(self.opt.state.conv)}
+        return {"conv": self.opt._read_conv()}
 
     def sync(self):
         """One hub<->spoke exchange: harvest the spokes' previous async
@@ -155,14 +155,25 @@ class PHHub(Hub):
         self._iter += 1
         period = max(1, int(self.options.get("spoke_sync_period", 1)))
         do_spokes = (self._iter <= 2) or (self._iter % period == 0)
+        # fused spokes (algos.fused_wheel) compute inside the hub's own
+        # jitted step — harvesting them is a scalar read, so they fold
+        # EVERY iteration; classic spokes keep the sync period
+        fused = [sp for sp in self.spokes if getattr(sp, "fused", False)]
+        classic = [sp for sp in self.spokes if not getattr(sp, "fused",
+                                                           False)]
+        self._harvest_all(only=fused)
         if do_spokes:
-            self._harvest_all()
+            self._harvest_all(only=classic)
         self._fold_own_bounds()
-        payload = self._snapshot()
-        self.from_hub.put(payload)  # for API parity / inspection
-        if do_spokes:
-            for sp in self.spokes:
-                sp.update(payload)
+        # building the snapshot dispatches a (small) device gather; with
+        # an all-fused wheel no consumer exists, so skip it off-sync
+        if (do_spokes and classic) or self.options.get("publish_snapshots"):
+            payload = self._snapshot()
+            self.from_hub.put(payload)  # for API parity / inspection
+            if do_spokes:
+                for sp in classic:
+                    sp.update(payload)
+        self._maybe_checkpoint()
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
         import time as _time
@@ -180,6 +191,104 @@ class PHHub(Hub):
                 f" outer {self.BestOuterBound:12.5g}"
                 f" inner {self.BestInnerBound:12.5g} rel_gap {rel_gap:8.3e}"
                 f" ({self.latest_ob_char}/{self.latest_ib_char})", True)
+
+    # -- crash-resilient checkpointing (VERDICT r3 #2; the analog of the
+    # reference surviving solver/license hiccups, ref:spopt.py:931-960) --
+    def _maybe_checkpoint(self):
+        import time as _time
+        path = self.options.get("checkpoint_path")
+        if not path:
+            return
+        every = float(self.options.get("checkpoint_every_s", 60.0))
+        now = _time.perf_counter()
+        last = getattr(self, "_last_ckpt_t", None)
+        if last is None:
+            # first sync: start the clock, don't save yet
+            self._last_ckpt_t = now
+            return
+        if now - last < every:
+            return
+        self._last_ckpt_t = now
+        self.save_checkpoint(path)
+
+    def save_checkpoint(self, path: str):
+        """Atomic npz snapshot of the full wheel: solver state (wstate
+        for FusedPH, else PHState), hub bound bookkeeping, spoke bests,
+        and caller extras (options['checkpoint_extra'] -> dict)."""
+        import os
+
+        import jax
+        st = getattr(self.opt, "wstate", None)
+        which = "wstate" if st is not None else "state"
+        if st is None:
+            st = self.opt.state
+        leaves, _ = jax.tree.flatten(st)
+        data = {f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        data["which"] = np.frombuffer(which.encode(), np.uint8)
+        data["hub_iter"] = np.asarray(self._iter)
+        data["opt_iter"] = np.asarray(self.opt._iter)
+        data["bounds"] = np.asarray([self.BestOuterBound,
+                                     self.BestInnerBound])
+        data["ib_update_iter"] = np.asarray(self._inner_bound_update_iter)
+        tb = self.opt.trivial_bound
+        data["trivial"] = np.asarray([
+            np.nan if tb is None else tb,
+            1.0 if self.opt.trivial_bound_certified else 0.0,
+            1.0 if getattr(self, "_trivial_bound_folded", False) else 0.0])
+        for j, sp in enumerate(self.spokes):
+            if sp.bound is not None:
+                data[f"spoke{j}_bound"] = np.asarray(sp.bound)
+                bx = getattr(sp, "best_xhat", None)
+                if bx is not None:
+                    data[f"spoke{j}_xhat"] = np.asarray(bx)
+        extra = self.options.get("checkpoint_extra")
+        if callable(extra):
+            for k, v in extra().items():
+                data[f"extra_{k}"] = np.asarray(v)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **data)
+        os.replace(tmp, path)
+
+    def load_checkpoint(self, path: str) -> dict:
+        """Restore a save_checkpoint snapshot into the built (unspun)
+        wheel; ph_main then skips Iter0 and resumes the loop.  Returns
+        the extras dict."""
+        import jax
+        import jax.numpy as jnp
+        data = np.load(path)
+        which = bytes(data["which"]).decode()
+        template = self.opt.state_template()
+        leaves, treedef = jax.tree.flatten(template)
+        new = [jnp.asarray(data[f"leaf{i}"]) for i in range(len(leaves))]
+        for i, (a, b) in enumerate(zip(new, leaves)):
+            if tuple(a.shape) != tuple(b.shape):
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {a.shape} != expected "
+                    f"{b.shape} (different problem/options?)")
+        st = jax.tree.unflatten(treedef, new)
+        if which == "wstate":
+            self.opt.wstate = st
+            self.opt.state = st.ph
+        else:
+            self.opt.state = st
+        self._iter = int(data["hub_iter"])
+        self.opt._iter = int(data["opt_iter"])
+        ob, ib = [float(v) for v in data["bounds"]]
+        self.BestOuterBound, self.BestInnerBound = ob, ib
+        self._inner_bound_update_iter = int(data["ib_update_iter"])
+        tb, cert, folded = [float(v) for v in data["trivial"]]
+        self.opt.trivial_bound = None if math.isnan(tb) else tb
+        self.opt.trivial_bound_certified = bool(cert)
+        self._trivial_bound_folded = bool(folded)
+        for j, sp in enumerate(self.spokes):
+            key = f"spoke{j}_bound"
+            if key in data:
+                sp.bound = float(data[key])
+                if f"spoke{j}_xhat" in data:
+                    sp.best_xhat = np.asarray(data[f"spoke{j}_xhat"])
+        return {k[len("extra_"):]: data[k] for k in data.files
+                if k.startswith("extra_")}
 
     def is_converged(self) -> bool:
         # use the PH trivial bound as the initial outer bound
@@ -201,7 +310,10 @@ class PHHub(Hub):
         return self.opt.ph_main()
 
     def finalize(self):
-        # one last harvest so late async results count
+        # one last harvest so late async results count; fused drivers
+        # first sync their pipelined scalar cache to the final iterate
+        if hasattr(self.opt, "flush_scalars"):
+            self.opt.flush_scalars()
         self._harvest_all()
         return self.BestInnerBound
 

@@ -121,6 +121,130 @@ class InnerBoundSpoke(Spoke):
 
 
 # ---------------------------------------------------------------------------
+# Fused spokes (pair with algos.fused_wheel.FusedPH): the device work
+# lives INSIDE the hub's jitted step; these objects only read the
+# resulting scalars at harvest.  `fused = True` makes the hub
+# harvest them every iteration (they are free) regardless of
+# spoke_sync_period.
+# ---------------------------------------------------------------------------
+class FusedLagrangianOuterBound(OuterBoundSpoke):
+    """Reads the in-step Lagrangian bound off FusedWheelState — the
+    fused analog of LagrangianOuterBound (same certificate gating)."""
+
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.W_GETTER)
+    converger_spoke_char = "L"
+    fused = True
+
+    def update(self, hub_payload):
+        pass  # computation rides inside FusedPH's jitted step
+
+    def harvest(self):
+        sc = getattr(self.opt, "scalar_cache", None)
+        if sc is None:
+            return self.bound
+        if sc["lag_certified"] > 0.5:
+            b = sc["lag_bound"]
+            if self.bound is None or b > self.bound:
+                self.bound = b
+        return self.bound
+
+
+class FusedXhatXbarInnerBound(InnerBoundSpoke):
+    """Reads the in-step x̂ = round(x̄) recourse value off
+    FusedWheelState — the fused analog of XhatXbarInnerBound.
+
+    Fallback: if the truncated in-loop evaluation has not produced a
+    feasible value for `rescue_after` consecutive harvests (a stalled
+    recourse tail), one blocking full evaluation with the rescue tiers
+    runs at harvest — bounded, and amortized to once per stall."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+    converger_spoke_char = "X"
+    fused = True
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        self.rescue_after = int(self.options.get("rescue_after", 40))
+        self._dry_harvests = 0
+
+    def update(self, hub_payload):
+        pass
+
+    def harvest(self):
+        sc = getattr(self.opt, "scalar_cache", None)
+        if sc is None:
+            return self.bound
+        if sc["xhat_feasible"] > 0.5:
+            self._dry_harvests = 0
+            # cand_cache rides the same pipeline as scalar_cache, so the
+            # value is always paired with the candidate it was evaluated
+            # at; the tensor transfers only on an actual offer
+            if self.bound is None or sc["xhat_value"] < self.bound:
+                self._offer(sc["xhat_value"],
+                            np.asarray(self.opt.cand_cache["xhat"]))
+            return self.bound
+        self._dry_harvests += 1
+        if self._dry_harvests >= self.rescue_after:
+            self._dry_harvests = 0
+            cand = jnp.asarray(self.opt.cand_cache["xhat"])
+            res = xhat_mod.evaluate(self.batch, cand, self.pdhg_opts)
+            if bool(res.feasible):
+                self._offer(float(res.value), np.asarray(cand))
+        return self.bound
+
+
+class FusedXhatShuffleInnerBound(InnerBoundSpoke):
+    """Reads the in-step rotating-scenario candidate value off
+    FusedWheelState (enable with FusedWheelOptions.shuffle_windows > 0)
+    — the fused analog of XhatShuffleInnerBound: one shuffled scenario's
+    own first stage per wheel iteration instead of k per sync."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+    converger_spoke_char = "F"
+    fused = True
+
+    def update(self, hub_payload):
+        pass
+
+    def harvest(self):
+        sc = getattr(self.opt, "scalar_cache", None)
+        if sc is None:
+            return self.bound
+        if sc["shuf_feasible"] > 0.5 and (self.bound is None
+                                          or sc["shuf_value"] < self.bound):
+            self._offer(sc["shuf_value"],
+                        np.asarray(self.opt.cand_cache["shuf"]))
+        return self.bound
+
+
+class FusedSlamHeuristic(InnerBoundSpoke):
+    """Reads the in-step slam-candidate recourse value off
+    FusedWheelState (enable with FusedWheelOptions.slam_windows > 0) —
+    the fused analog of SlamMaxHeuristic/SlamMinHeuristic."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+    converger_spoke_char = "S"
+    fused = True
+
+    def update(self, hub_payload):
+        pass
+
+    def harvest(self):
+        sc = getattr(self.opt, "scalar_cache", None)
+        if sc is None:
+            return self.bound
+        if sc["slam_feasible"] > 0.5 and (self.bound is None
+                                          or sc["slam_value"] < self.bound):
+            self._offer(sc["slam_value"],
+                        np.asarray(self.opt.cand_cache["slam"]))
+        return self.bound
+
+
+# ---------------------------------------------------------------------------
 # Outer bounds
 # ---------------------------------------------------------------------------
 class LagrangianOuterBound(OuterBoundSpoke):

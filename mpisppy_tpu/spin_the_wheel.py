@@ -31,10 +31,12 @@ class WheelSpinner:
         self.opt = None
         self.on_hub = True  # single-process: we always "are" the hub
 
-    def spin(self, comm_world=None):
-        """Build opt + hub + spokes, run the hub algorithm to
-        completion, terminate + finalize the spokes
-        (ref:spin_the_wheel.py:43-149 run())."""
+    def build(self):
+        """Construct opt + spokes + hub without running (split out so a
+        checkpoint can be restored into the built objects before
+        spin())."""
+        if self.spcomm is not None:
+            return self
         hd = self.hub_dict
         opt_class = hd["opt_class"]
         self.opt = opt_class(**hd.get("opt_kwargs", {}))
@@ -51,6 +53,13 @@ class WheelSpinner:
                                 spokes=spokes)
         self.spcomm.make_windows()
         self.spcomm.setup_hub()
+        return self
+
+    def spin(self, comm_world=None):
+        """Build opt + hub + spokes, run the hub algorithm to
+        completion, terminate + finalize the spokes
+        (ref:spin_the_wheel.py:43-149 run())."""
+        self.build()
         global_toc("Starting wheel spin", False)
         self.spcomm.main()
         self.spcomm.send_terminate()

@@ -152,3 +152,45 @@ def test_presolve_detects_infeasible_scenario():
     # makes the whole stochastic program infeasible (same effect as the
     # reference's bound Allreduce, ref:mpisppy/opt/presolve.py:183-260)
     assert info["infeasible"].all()
+
+
+def test_fbbt_infinite_terms_do_not_fabricate_bounds():
+    # ADVICE r3 (medium): with an unbounded column in the row, clipped
+    # 1e30 activity sums absorbed the finite terms and the derived bound
+    # for the unbounded column ignored the other columns' real activity.
+    # Row: x0 + x1 <= 10, x0 in [-5, 5], x1 in (-inf, inf).
+    # True implication: x1 <= 10 - min(x0) = 15 (NOT 10).
+    qp = _qp([0.0, 0.0], [[1.0, 1.0]], [-np.inf], [10.0],
+             [-5.0, -np.inf], [5.0, np.inf])
+    l, u = fbbt.fbbt(qp, n_sweeps=1)  # noqa: E741
+    u = np.asarray(u)
+    l = np.asarray(l)  # noqa: E741
+    assert u[1] >= 15.0 - 1e-4, f"invalid tightening: u1={u[1]}"
+    assert u[1] <= 15.0 + 1e-4, f"missed valid tightening: u1={u[1]}"
+    # x0's own bound must be untouched by the side carrying x1's
+    # infinity (two infinite terms would remain after excluding x0)
+    assert u[0] == 5.0 and l[0] == -5.0
+
+
+def test_fbbt_two_infinite_terms_skip_tightening():
+    # Row: x0 + x1 + x2 <= 10 with x1, x2 both unbounded below: no
+    # column may be tightened from this row's upper side (excluding any
+    # single j still leaves an infinite min-term).
+    qp = _qp([0.0] * 3, [[1.0, 1.0, 1.0]], [-np.inf], [10.0],
+             [0.0, -np.inf, -np.inf], [np.inf, np.inf, np.inf])
+    l, u = fbbt.fbbt(qp, n_sweeps=2)  # noqa: E741
+    assert np.all(np.isinf(np.asarray(u)))
+
+
+def test_fbbt_single_infinite_term_tightens_only_owner():
+    # Row: 2 x0 - x1 <= 8, x0 in [0, inf), x1 in [0, 4]:
+    #   x0 <= (8 + max(x1)) / 2 = 6   (x0's min-term is the infinite one
+    #   -> excluded exactly; x1's finite activity must count)
+    qp = _qp([0.0, 0.0], [[2.0, -1.0]], [-np.inf], [8.0],
+             [0.0, 0.0], [np.inf, 4.0])
+    l, u = fbbt.fbbt(qp, n_sweeps=1)  # noqa: E741
+    u = np.asarray(u)
+    assert abs(u[0] - 6.0) < 1e-4, f"u0={u[0]}"
+    # x1 cannot be tightened from this row (x0's term is infinite after
+    # excluding x1), and no other row exists
+    assert np.isinf(u[1]) or u[1] == 4.0
