@@ -130,9 +130,12 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
     from mpisppy_tpu.spin_the_wheel import WheelSpinner
 
     ckpt = os.path.abspath(f".bench_ckpt_{label}.npz")
+    # checkpoint cadence trades crash-replay time against steady-state
+    # overhead: a full-wheel snapshot at 10k scenarios is ~460 MB
+    # (several seconds through the device tunnel), so save sparsely
     hub_opts = {"rel_gap": GAP_TARGET,
                 "checkpoint_path": ckpt,
-                "checkpoint_every_s": 30.0}
+                "checkpoint_every_s": 120.0}
     hub_opts.update(extra_hub_opts or {})
     hub = {
         "hub_class": hub_mod.PHHub,

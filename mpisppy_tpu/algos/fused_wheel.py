@@ -290,14 +290,15 @@ class FusedPH(ph_mod.PH):
 
     def state_template(self):
         st, _, _ = jax.eval_shape(
-            partial(fused_iter0, opts=self.options,
+            partial(fused_iter0, opts=ph_mod.kernel_opts(self.options),
                     wopts=self.wheel_options),
             self.batch, self.rho)
         return st
 
     def _iter0_impl(self):
         self.wstate, tb, cert = fused_iter0(
-            self.batch, self.rho, self.options, self.wheel_options)
+            self.batch, self.rho, ph_mod.kernel_opts(self.options),
+            self.wheel_options)
         self._cache_scalars()
         return self.wstate.ph, tb, cert
 
@@ -319,6 +320,6 @@ class FusedPH(ph_mod.PH):
         self.wstate = fused_iterk(
             self.batch,
             dataclasses.replace(self.wstate, ph=self.state),
-            self.options, wopts, sid)
+            ph_mod.kernel_opts(self.options), wopts, sid)
         self._cache_scalars(pipelined=True)
         return self.wstate.ph

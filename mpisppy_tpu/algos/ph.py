@@ -143,6 +143,17 @@ def iter0_solve_and_certify(batch: ScenarioBatch, windows: int,
     return solver, batch.expectation(dual), certified
 
 
+def kernel_opts(opts: PHOptions) -> PHOptions:
+    """Normalize host-loop-only fields (iteration caps, display, time
+    limits) to fixed values before an options object becomes a jit
+    static argument: they do not affect the compiled program, and
+    letting them into the hash caused spurious recompiles (a multi-
+    minute remote compile per distinct max_iterations value)."""
+    return dataclasses.replace(
+        opts, default_rho=0.0, max_iterations=0, conv_thresh=0.0,
+        display_progress=False, time_limit=None)
+
+
 @partial(jax.jit, static_argnames=("opts",))
 def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
     """Iter0: plain scenario solves, xbar, W seed, trivial bound
@@ -246,16 +257,17 @@ class PH:
         """Abstract (shape/dtype) pytree of this driver's state — the
         unflatten template for checkpoint restore (hub.load_checkpoint)
         without paying an Iter0 solve."""
-        st, _, _ = jax.eval_shape(partial(ph_iter0, opts=self.options),
-                                  self.batch, self.rho)
+        st, _, _ = jax.eval_shape(
+            partial(ph_iter0, opts=kernel_opts(self.options)),
+            self.batch, self.rho)
         return st
 
     # -- algorithm step hooks (overridden by APH) -------------------------
     def _iter0_impl(self):
-        return ph_iter0(self.batch, self.rho, self.options)
+        return ph_iter0(self.batch, self.rho, kernel_opts(self.options))
 
     def _iterk_impl(self):
-        return ph_iterk(self.batch, self.state, self.options)
+        return ph_iterk(self.batch, self.state, kernel_opts(self.options))
 
     def _iter_msg(self, k: int, conv: float) -> str:
         return f"{self._label} iter {k}: conv = {conv:.3e}"

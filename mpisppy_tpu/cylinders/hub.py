@@ -103,6 +103,14 @@ class PHHub(Hub):
         self.opt.spcomm = self
         for sp in self.spokes:
             sp.make_windows()
+        # hub-side extension hooks (ref:mpisppy/cylinders/hub.py:476-516
+        # setup_hub drives the extension's setup + spoke-index wiring)
+        ext = getattr(self.opt, "extobject", None)
+        if ext is not None:
+            if hasattr(ext, "setup_hub"):
+                ext.setup_hub()
+            if hasattr(ext, "initialize_spoke_indices"):
+                ext.initialize_spoke_indices()
 
     def _snapshot(self) -> dict:
         """Device-array snapshot for spokes (ref:hub.py:517-532 sends
@@ -164,6 +172,12 @@ class PHHub(Hub):
         self._harvest_all(only=fused)
         if do_spokes:
             self._harvest_all(only=classic)
+            # extension exchange with the spokes it cares about
+            # (ref:mpisppy/cylinders/hub.py:517-532 drives the
+            # extension's sync_with_spokes every sync)
+            ext = getattr(self.opt, "extobject", None)
+            if ext is not None and hasattr(ext, "sync_with_spokes"):
+                ext.sync_with_spokes()
         self._fold_own_bounds()
         # building the snapshot dispatches a (small) device gather; with
         # an all-fused wheel no consumer exists, so skip it off-sync
@@ -209,13 +223,21 @@ class PHHub(Hub):
         if now - last < every:
             return
         self._last_ckpt_t = now
-        self.save_checkpoint(path)
+        self.save_checkpoint(path, background=True)
 
-    def save_checkpoint(self, path: str):
+    def save_checkpoint(self, path: str, background: bool = False):
         """Atomic npz snapshot of the full wheel: solver state (wstate
         for FusedPH, else PHState), hub bound bookkeeping, spoke bests,
-        and caller extras (options['checkpoint_extra'] -> dict)."""
+        and caller extras (options['checkpoint_extra'] -> dict).
+
+        background=True writes from a daemon thread: a full-wheel
+        snapshot at 10k scenarios is ~460 MB, and fetching it through
+        the device tunnel synchronously (~50 s measured) would gate the
+        hub loop.  The state pytree is immutable and device_get is
+        thread-safe, so the transfer overlaps compute; at most one save
+        is in flight (later requests are skipped, not queued)."""
         import os
+        import threading
 
         import jax
         st = getattr(self.opt, "wstate", None)
@@ -223,7 +245,23 @@ class PHHub(Hub):
         if st is None:
             st = self.opt.state
         leaves, _ = jax.tree.flatten(st)
-        data = {f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        if background:
+            prev = getattr(self, "_ckpt_thread", None)
+            if prev is not None and prev.is_alive():
+                return
+            host_meta = self._checkpoint_meta(which)
+            t = threading.Thread(
+                target=self._write_checkpoint,
+                args=(path, leaves, host_meta), daemon=True)
+            self._ckpt_thread = t
+            t.start()
+            return
+        self._write_checkpoint(path, leaves, self._checkpoint_meta(which))
+
+    def _checkpoint_meta(self, which: str) -> dict:
+        """Host-side bookkeeping captured SYNCHRONOUSLY (the mutable
+        bits; device leaves are immutable and can transfer later)."""
+        data = {}
         data["which"] = np.frombuffer(which.encode(), np.uint8)
         data["hub_iter"] = np.asarray(self._iter)
         data["opt_iter"] = np.asarray(self.opt._iter)
@@ -245,6 +283,12 @@ class PHHub(Hub):
         if callable(extra):
             for k, v in extra().items():
                 data[f"extra_{k}"] = np.asarray(v)
+        return data
+
+    def _write_checkpoint(self, path: str, leaves, data: dict):
+        import os
+        for i, x in enumerate(leaves):
+            data[f"leaf{i}"] = np.asarray(x)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **data)
@@ -315,6 +359,11 @@ class PHHub(Hub):
         if hasattr(self.opt, "flush_scalars"):
             self.opt.flush_scalars()
         self._harvest_all()
+        # settle any in-flight background checkpoint write so the file
+        # on disk is complete before the caller inspects/deletes it
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
         return self.BestInnerBound
 
     def hub_finalize(self):

@@ -87,6 +87,14 @@ class CrossScenarioExtension(Extension):
             global_toc(f"cross-scen EF bound: {bound:.6g}",
                        self.opt.options.display_progress)
 
+    def sync_with_spokes(self):
+        """Hub-driven exchange point (ref:cross_scen_extension.py via
+        hub.py:517-532): pull any fresh cut package off the cut spoke
+        and install it.  Idempotent with the miditer pull (gated on the
+        spoke's new_cuts flag), so bare-PH runs without a hub-driven
+        hook plane still work."""
+        self._get_cuts()
+
     def miditer(self):
         self._get_cuts()
         if self.check_bound_iterations is None or not self.any_cuts:

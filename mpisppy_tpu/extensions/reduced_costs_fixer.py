@@ -99,6 +99,13 @@ class ReducedCostsFixer(Extension):
         ))
 
     # -- the work ---------------------------------------------------------
+    def sync_with_spokes(self):
+        """Hub-driven exchange point (ref:reduced_costs_fixer via
+        hub.py:517-532): consume fresh reduced costs as soon as the hub
+        harvests them.  Idempotent with the miditer pull (gated on the
+        spoke's new_rc flag)."""
+        self.miditer()
+
     def miditer(self):
         sp = self._spoke()
         if sp is None or not sp.new_rc or sp.rc_global is None:

@@ -72,6 +72,11 @@ class PDHGOptions:
     max_window: int = 16        # forced restart after this many periods
     detect_infeas: bool = False  # per-problem Farkas/recession certificates
     certificate_tol: float = 1e-4
+    # window-iteration engine: None = auto (the Pallas VMEM-resident
+    # window kernel on TPU for dense shared-A batches at scale — the
+    # 100k-scenario HBM-bandwidth fix, ops/pdhg_pallas.py — else the
+    # XLA fori_loop); True/False forces.
+    use_pallas: bool | None = None
 
 
 @partial(
@@ -269,12 +274,30 @@ def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     )
 
 
+def _use_pallas_window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> bool:
+    """Engine choice, resolved at TRACE time (all inputs static)."""
+    if opts.use_pallas is not None:
+        return bool(opts.use_pallas)
+    from mpisppy_tpu.ops import pdhg_pallas
+    return (jax.default_backend() == "tpu"
+            and pdhg_pallas.supported(p)
+            and st.x.ndim == 2 and st.x.shape[0] >= 2048)
+
+
 def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     tau = opts.step_margin * st.omega / st.Lnorm
     sigma = opts.step_margin / (st.omega * st.Lnorm)
-    st = jax.lax.fori_loop(
-        0, opts.restart_period, lambda _, s: _pdhg_iter(p, s, tau, sigma), st
-    )
+    if _use_pallas_window(p, st, opts):
+        from mpisppy_tpu.ops import pdhg_pallas
+        interp = jax.default_backend() != "tpu"
+        x, y, xs, ys = pdhg_pallas.run_window(
+            p, st.x, st.y, st.x_sum, st.y_sum, tau, sigma, st.done,
+            opts.restart_period, interpret=interp)
+        st = dataclasses.replace(st, x=x, y=y, x_sum=xs, y_sum=ys)
+    else:
+        st = jax.lax.fori_loop(
+            0, opts.restart_period,
+            lambda _, s: _pdhg_iter(p, s, tau, sigma), st)
     st = dataclasses.replace(st, nwin=st.nwin + opts.restart_period)
     st = _restart(p, st, opts)
     return dataclasses.replace(st, k=st.k + opts.restart_period)

@@ -100,3 +100,51 @@ def test_xhat_looper_and_specific_spokes():
     # farmer inner bounds must be >= EF optimum (min problem)
     assert wheel.BestInnerBound >= -108390.0 - 200.0
     assert np.isfinite(wheel.BestInnerBound)
+
+
+def test_wheel_drives_hub_side_extension_hooks():
+    """The FULL hook plane in a wheel run: the hub drives setup_hub /
+    initialize_spoke_indices at wheel setup and sync_with_spokes every
+    sync (ref:mpisppy/cylinders/hub.py:476-532), on top of PH's own
+    iteration callouts — round-3 review weak #8."""
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.cylinders.spoke import LagrangianOuterBound
+    from mpisppy_tpu.extensions.test_extension import TestExtension
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    hub = {
+        "hub_class": hub_mod.PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-9}},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_mod.PHOptions(max_iterations=3),
+                       "batch": batch,
+                       "extensions": TestExtension},
+    }
+    spokes = [{"spoke_class": LagrangianOuterBound,
+               "opt_kwargs": {"options": {}}}]
+    ws = WheelSpinner(hub, spokes).spin()
+    calls = ws.opt._TestExtension_who_is_called
+    # hub setup fires the two wiring hooks BEFORE any PH hook
+    assert calls[:2] == ["setup_hub", "initialize_spoke_indices"], calls
+    # iter0 block, with the hub's sync_with_spokes inside the Iter0 sync
+    assert calls[2:6] == ["pre_iter0", "iter0_post_solver_creation",
+                          "post_iter0", "sync_with_spokes"], calls
+    assert calls[6] == "post_iter0_after_sync", calls
+    # every iterk sync drives sync_with_spokes between enditer and
+    # enditer_after_sync (the spcomm.sync callout point)
+    k_block = ["miditer", "pre_solve_loop", "post_solve_loop", "enditer",
+               "sync_with_spokes", "enditer_after_sync"]
+    assert calls[7:13] == k_block, calls
+    assert calls[-1] == "post_everything", calls
+    # all 13 batched-design callout points fired (pre_solve/post_solve
+    # have no per-subproblem callout in the one-program design)
+    assert set(calls) == {
+        "setup_hub", "initialize_spoke_indices", "sync_with_spokes",
+        "pre_iter0", "iter0_post_solver_creation", "post_iter0",
+        "post_iter0_after_sync", "miditer", "pre_solve_loop",
+        "post_solve_loop", "enditer", "enditer_after_sync",
+        "post_everything"}, calls

@@ -120,7 +120,9 @@ def test_fused_wheel_checkpoint_resume(tmp_path):
                                       hub_extra=hub_extra, rho=20.0),
                        ALL_FUSED_SPOKES[:2]).build()
     ws2.spcomm.load_checkpoint(ckpt)
-    assert ws2.spcomm._iter == it1
+    # checkpoints write from a background thread, so the saved iteration
+    # may lag the final counter — it must be a valid earlier sync point
+    assert 0 < ws2.spcomm._iter <= it1
     # the final flush after the last checkpoint may have improved the
     # bound by up to one pipelined iteration — restored must be a valid,
     # no-better snapshot of the final bookkeeping
