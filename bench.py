@@ -364,10 +364,161 @@ def bench_uc_fwph():
         extra_hub_opts={"spoke_sync_period": 5})
 
 
+def bench_hydro():
+    """BASELINE.md item 4: hydro 3-stage wheel (multistage path —
+    node-segmented reductions) to 1% certified gap.  Scales the (3, 3)
+    SIPLIB-style tree by widening the stage-2/3 branching."""
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.cylinders import spoke as spoke_mod
+    from mpisppy_tpu.models import hydro
+    from mpisppy_tpu.ops import pdhg
+
+    bfs = (3, 3) if SMOKE else ((10, 10) if QUICK else (30, 30))
+    num = bfs[0] * bfs[1]
+    specs = [hydro.scenario_creator(nm, branching_factors=bfs)
+             for nm in hydro.scenario_names_creator(num)]
+    batch = batch_mod.from_specs(specs, tree=hydro.make_tree(bfs))
+    ph_opts = ph_mod.PHOptions(
+        default_rho=10.0, max_iterations=MAX_WHEEL_ITERS, conv_thresh=0.0,
+        subproblem_windows=8,
+        pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+    spokes = [
+        {"spoke_class": spoke_mod.FusedLagrangianOuterBound,
+         "opt_kwargs": {"options": {}}},
+        {"spoke_class": spoke_mod.FusedXhatXbarInnerBound,
+         "opt_kwargs": {"options": {}}},
+    ]
+    return bench_wheel_to_gap(batch, f"hydro_3stage_{num}scen", spokes,
+                              ph_opts)
+
+
+def bench_measured_mfu():
+    """VERDICT r3 weak #6: measured (not modeled) FLOP/s and HBM
+    bandwidth for the PH step.  Uses XLA's compiled cost analysis
+    (flops + bytes accessed of the EXACT program run) divided by
+    measured wall-clock, alongside the analytic matvec model, plus a
+    jax.profiler device trace saved as an artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.ops import pdhg
+
+    out = {}
+    scales = [16] if SMOKE else ([1_000] if QUICK else [10_000, 100_000])
+    for S in scales:
+        batch, _ = _sslp_batch(S)
+        opts = ph_mod.PHOptions(
+            default_rho=20.0, subproblem_windows=8,
+            iter0_windows=80 if S >= 100_000 else 400,
+            pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
+        ko = ph_mod.kernel_opts(opts)
+        rho = jnp.full((batch.num_nonants,), opts.default_rho)
+        state, _, _ = ph_mod.ph_iter0(batch, rho, ko)
+        compiled = ph_mod.ph_iterk.lower(batch, state, ko).compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", float("nan")))
+            bytes_acc = float(ca.get("bytes accessed", float("nan")))
+        except Exception as e:  # pragma: no cover - backend-specific
+            flops, bytes_acc = float("nan"), float("nan")
+            out.setdefault("cost_analysis_error", repr(e))
+        state = ph_mod.ph_iterk(batch, state, ko)
+        jax.block_until_ready(state.conv)
+        n = 3 if S >= 100_000 else 10
+        # device trace artifact for one iteration
+        trace_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)) or ".",
+            f"profile_trace_S{S}")
+        try:
+            with jax.profiler.trace(trace_dir):
+                st2 = ph_mod.ph_iterk(batch, state, ko)
+                jax.block_until_ready(st2.conv)
+        except Exception as e:  # pragma: no cover
+            out.setdefault("trace_error", repr(e))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state = ph_mod.ph_iterk(batch, state, ko)
+        jax.block_until_ready(state.conv)
+        dt = (time.perf_counter() - t0) / n
+        model_flops = _flops_per_ph_iter(batch, opts)
+
+        # hot-op microbenchmarks at the EXACT bench shapes — genuinely
+        # measured achieved rates (the cost-analysis figures above count
+        # while/fori loop bodies ONCE, so they undercount by the
+        # iteration trip count; these do not)
+        A = batch.qp.A
+        if hasattr(A, "k"):
+            mm = None  # ELL path: matvec is gather-based, not a GEMM
+        else:
+            X = state.solver.x
+            AT = jnp.asarray(A).T
+
+            @jax.jit
+            def matvec_pair(X, y):
+                y2 = jax.lax.dot_general(
+                    X, AT, (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)
+                x2 = jax.lax.dot_general(
+                    y2, jnp.asarray(A), (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST)
+                return x2, y2
+
+            x2, y2 = matvec_pair(X, state.solver.y)
+            jax.block_until_ready(x2)
+            reps = 20
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                x2, y2 = matvec_pair(x2, y2)
+            jax.block_until_ready(x2)
+            mv_dt = (time.perf_counter() - t0) / reps
+            mm_flops = 4.0 * S * A.shape[-2] * A.shape[-1]
+            mm = round(mm_flops / mv_dt / 1e12, 3)
+
+        @jax.jit
+        def saxpy(a, b):
+            return a * 1.0001 + b
+
+        a, b = state.solver.x, state.solver.x_sum
+        c_ = saxpy(a, b)
+        jax.block_until_ready(c_)
+        reps = 30
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            c_ = saxpy(c_, b)
+        jax.block_until_ready(c_)
+        sx_dt = (time.perf_counter() - t0) / reps
+        stream_gbps = round(3.0 * a.size * a.dtype.itemsize / sx_dt / 1e9,
+                            1)
+
+        out[f"S{S}"] = {
+            "sec_per_iter": round(dt, 4),
+            "xla_flops_per_iter_body_once": flops,
+            "xla_bytes_per_iter_body_once": bytes_acc,
+            "model_tflops": round(model_flops / dt / 1e12, 3),
+            "measured_matvec_tflops": mm,
+            "measured_stream_gbps": stream_gbps,
+            "trace_dir": trace_dir,
+        }
+    out["note"] = ("xla_*_body_once are compiled cost-analysis figures "
+                   "that count loop bodies once (no trip-count fold); "
+                   "measured_matvec_tflops / measured_stream_gbps are "
+                   "direct timings of the two hot ops at bench shapes")
+    # v5e single-chip peaks for context (public spec)
+    out["v5e_peak_bf16_tflops"] = 197.0
+    out["v5e_peak_hbm_gbps"] = 819.0
+    return out
+
+
 _PHASES = {
     "sslp_to_1pct_gap": lambda: bench_sslp_gap(),
     "uc_fwph_to_1pct_gap": lambda: bench_uc_fwph(),
+    "hydro_to_1pct_gap": lambda: bench_hydro(),
     "wheel_overhead": lambda: bench_wheel_overhead(),
+    "measured_mfu": lambda: bench_measured_mfu(),
 }
 for _S in SWEEP:
     _PHASES[f"sweep_{_S}"] = (lambda S=_S: bench_sweep_one(S))

@@ -101,6 +101,153 @@ def evaluate_mip(batch: ScenarioBatch, xhat: Array,
     }
 
 
+def evaluate_mip_many(batch: ScenarioBatch, xhats,
+                      opts: BnBOptions = BnBOptions()) -> list[dict]:
+    """Certified MIP inner bounds for K candidate first stages in ONE
+    batched B&B of K*S subproblems (the TPU answer to the reference's
+    shuffle looper trying candidates sequentially across ranks,
+    ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:23-157).
+    Returns one evaluate_mip-style dict per candidate."""
+    K = len(xhats)
+    if K == 0:
+        return []
+    S = batch.num_scenarios
+    n = batch.qp.c.shape[-1]
+    qps = []
+    for xh in xhats:
+        xh = jnp.asarray(xh)
+        xh = jnp.where(batch.integer_slot, jnp.round(xh), xh)
+        qps.append(batch.with_fixed_nonants(xh))
+
+    def tileS(x, batched_ndim):
+        if hasattr(x, "vals"):  # EllMatrix
+            return dataclasses.replace(x, vals=tileS(x.vals, batched_ndim))
+        if getattr(x, "ndim", 0) != batched_ndim:
+            return x  # shared: broadcasts across the K*S batch
+        return jnp.tile(x, (K,) + (1,) * (batched_ndim - 1))
+
+    qp0 = batch.qp
+    qp = dataclasses.replace(
+        qp0,
+        c=tileS(qp0.c, 2), q=tileS(qp0.q, 2), A=tileS(qp0.A, 3),
+        bl=tileS(qp0.bl, 2), bu=tileS(qp0.bu, 2),
+        l=jnp.concatenate([q.l for q in qps], axis=0),
+        u=jnp.concatenate([q.u for q in qps], axis=0))
+    d_col = tileS(batch.d_col, 2)
+    res = bnb.solve_mip(qp, d_col, _int_cols(batch), opts)
+    p = np.asarray(batch.p)
+    real = p > 0.0
+    feas_ks = np.asarray(res.feasible).reshape(K, S)
+    inner_ks = np.asarray(res.inner).reshape(K, S)
+    outer_ks = np.asarray(res.outer).reshape(K, S)
+    out = []
+    for k in range(K):
+        feas = bool(np.all(np.where(real, feas_ks[k], True)))
+        value = float(np.sum(np.where(real, p * inner_ks[k], 0.0))) \
+            if feas else float("inf")
+        out.append({
+            "value": value,
+            "value_lower": float(np.sum(np.where(real, p * outer_ks[k],
+                                                 0.0))),
+            "per_scenario": inner_ks[k],
+            "feasible": feas,
+            "xhat": np.asarray(
+                jnp.where(batch.integer_slot, jnp.round(jnp.asarray(
+                    xhats[k])), jnp.asarray(xhats[k]))),
+        })
+    return out
+
+
+def first_stage_local_search(batch: ScenarioBatch, xhat0, inner0: float,
+                             opts: BnBOptions = BnBOptions(),
+                             max_rounds: int = 8,
+                             verbose: bool = False) -> dict:
+    """1-flip local search over the INTEGER first-stage slots, each
+    round one batched evaluate_mip_many over all neighbors — the
+    batched analog of slam/looper-style incumbent improvement, and the
+    standard local-branching move for closing the inner side of a MIP
+    bracket (no reference analog: Gurobi's heuristics play this role
+    for the reference, ref:mpisppy/spopt.py:884)."""
+    int_slots = np.nonzero(np.asarray(batch.integer_slot))[0]
+    lb, ub = batch.nonant_box()
+    best = np.asarray(xhat0, float).copy()
+    best_val = float(inner0)
+    for rnd in range(max_rounds):
+        cands = []
+        for j in int_slots:
+            for v in (best[j] - 1.0, best[j] + 1.0):
+                if lb[j] - 1e-6 <= v <= ub[j] + 1e-6:
+                    c = best.copy()
+                    c[j] = v
+                    cands.append(c)
+        evs = evaluate_mip_many(batch, cands, opts)
+        vals = [e["value"] if e["feasible"] else float("inf") for e in evs]
+        k = int(np.argmin(vals)) if vals else 0
+        if not vals or vals[k] >= best_val - 1e-9:
+            break
+        best_val = vals[k]
+        best = np.asarray(cands[k], float)
+        if verbose:
+            print(f"[ls] round {rnd}: inner -> {best_val:.6g}")
+    return {"xhat": best, "value": best_val}
+
+
+def mip_dual_ascent_polyak(batch: ScenarioBatch, W, inner: float,
+                           steps: int, opts: BnBOptions = BnBOptions(),
+                           lam0: float = 1.0, target: float | None = None,
+                           verbose: bool = False) -> dict:
+    """Polyak-step subgradient ascent on the INTEGER Lagrangian dual:
+
+        step_t = lam * (inner - L(W_t)) / ||g_t||_p^2,
+        g_t    = x_t - xbar_t   (p-weighted node-mean-zero by
+                                 construction, preserving the PH
+                                 invariant that makes L(W) valid)
+
+    with lam halved after two non-improving steps — the classical
+    dual-decomposition recipe (Caroe & Schultz) the reference's exact
+    solvers make unnecessary (ref:mpisppy/cylinders/
+    lagrangian_bounder.py gets L(W) from Gurobi's bestbound).  Each
+    step is one batched scenario-MIP solve.  Stops early at `target`.
+    Returns {'bound','W','history'}."""
+    W = jnp.asarray(W)
+    best, best_W = -float("inf"), W
+    lam, since = float(lam0), 0
+    p = np.asarray(batch.p)
+    hist = []
+    for t in range(steps):
+        lag = lagrangian_mip_bound(batch, W, opts)
+        L = lag["bound"]
+        hist.append(L)
+        if verbose:
+            print(f"[polyak] step {t}: L = {L:.6g} (best {max(best, L):.6g}"
+                  f", lam {lam:.3g})")
+        if L > best:
+            best, best_W = L, W
+            since = 0
+        else:
+            since += 1
+            if since >= 2:
+                lam *= 0.5
+                since = 0
+        if target is not None and best >= target:
+            break
+        res = lag["result"]
+        feas = np.asarray(res.feasible)
+        if not bool(np.all(feas[p > 0.0])):
+            break  # no integer point to take a subgradient from
+        x_non = jnp.asarray(res.x)[:, batch.nonant_idx]
+        xbar, _ = batch.node_average(x_non)
+        g = x_non - xbar
+        gnorm2 = float(jnp.sum(jnp.asarray(p)[:, None] * g * g))
+        if gnorm2 <= 1e-12 or not np.isfinite(inner):
+            break
+        step = lam * max(inner - L, 0.0) / gnorm2
+        if step <= 0.0:
+            break
+        W = W + step * g
+    return {"bound": best, "W": best_W, "history": hist}
+
+
 def ef_mip(ef_problem, specs, opts: BnBOptions = BnBOptions(),
            verbose: bool = False) -> dict:
     """Exact MIP solve of an assembled extensive form (algos/ef.py

@@ -57,6 +57,15 @@ def main(args=None):
         # sample fresh scenarios beyond the ones the candidate saw
         # (ref:mmw_conf.py start = num_scens of the xhat run)
         start = int(cfg.get("num_scens") or 0)
+        if start == 0:
+            # evaluating on the candidate's own training scenarios
+            # biases the gap estimate LOW and voids the CI coverage
+            # guarantee (cf. seqsampling._candidate_seed_span)
+            print("WARNING: neither --start-scen nor --num-scens given; "
+                  "gap estimation starts at scenario 0, which likely "
+                  "REUSES the scenarios the candidate xhat was fit to "
+                  "— the resulting CI is optimistically biased",
+                  file=sys.stderr)
     batch_size = cfg.get("MMW_batch_size") or cfg.get("num_scens")
     if batch_size is None:
         raise SystemExit("--MMW-batch-size (or --num-scens) is required")

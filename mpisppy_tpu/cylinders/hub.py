@@ -5,9 +5,13 @@
 # The reference hub Puts W/nonants into RMA windows and Gets bounds back,
 # with write-id consensus; here `sync()` hands the spokes a host-side
 # snapshot dict (device arrays — zero-copy) and harvests their previous
-# results.  Spokes launch device work asynchronously, so the PH hot loop
-# and the spoke solves pipeline on the device queue exactly like the
-# reference's concurrent cylinders — minus every lock and window.
+# results.  On ONE chip, classic spokes' separate device dispatches
+# SERIALIZE against the hub (round-3 measured 642x bare PH per
+# iteration for a 4-spoke wheel — async dispatch does NOT overlap work
+# on a single queue); the production answer is algos/fused_wheel.py,
+# which carries the bound planes INSIDE the hub's jitted step
+# (measured <=4.5x bare PH for the same 4 bound planes).  Classic
+# spokes remain for cut/rc providers and multi-process deployments.
 #
 # Termination semantics match ref:mpisppy/cylinders/hub.py:82-166:
 #   * rel_gap  <= options['rel_gap']   (gap = (inner-outer)/|inner|)

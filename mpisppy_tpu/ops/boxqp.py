@@ -308,10 +308,16 @@ def ruiz_scale(p: BoxQP, iters: int = 10) -> tuple[BoxQP, Scaling]:
         dr = np.ones(A.shape[:-1], A.dtype)
         dc = np.ones(A.shape[:-2] + (A.shape[-1],), A.dtype)
         for _ in range(iters):
-            rmax = np.maximum(np.max(np.abs(A), axis=-1), 1e-12)
+            # all-zero rows/cols (e.g. a variable absent from every
+            # constraint in some scenario) keep scale 1: flooring at a
+            # tiny epsilon instead would compound 1/sqrt(eps) per sweep
+            # into an inf scaling
+            rmax = np.max(np.abs(A), axis=-1)
+            rmax = np.where(rmax <= 0.0, 1.0, rmax)
             A = A / np.sqrt(rmax)[..., None]
             dr = dr / np.sqrt(rmax)
-            cmax = np.maximum(np.max(np.abs(A), axis=-2), 1e-12)
+            cmax = np.max(np.abs(A), axis=-2)
+            cmax = np.where(cmax <= 0.0, 1.0, cmax)
             A = A / np.sqrt(cmax)[..., None, :]
             dc = dc / np.sqrt(cmax)
         A_scaled = jnp.asarray(A, dt)

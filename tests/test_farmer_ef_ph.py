@@ -38,7 +38,8 @@ def scipy_ef_solve(specs):
                 A_ub.append(A[i]); b_ub.append(bu[i])
             if np.isfinite(bl[i]):
                 A_ub.append(-A[i]); b_ub.append(-bl[i])
-    res = linprog(c, A_ub=np.array(A_ub), b_ub=np.array(b_ub),
+    res = linprog(c, A_ub=np.array(A_ub) if A_ub else None,
+                  b_ub=np.array(b_ub) if b_ub else None,
                   A_eq=np.array(A_eq) if A_eq else None,
                   b_eq=np.array(b_eq) if b_eq else None,
                   bounds=list(zip(l, u)), method="highs")

@@ -1,0 +1,134 @@
+# Model-zoo tail (round 4): apl1p / gbd / stoch_distr — scipy EF
+# oracles + PH/ADMM end-to-end (the TPU analogs of
+# ref:mpisppy/tests/examples/{apl1p,gbd}.py and
+# ref:examples/stoch_distr/).
+import numpy as np
+import pytest
+
+from mpisppy_tpu.algos import ef as ef_mod
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import apl1p, distr, gbd, stoch_distr
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.utils.stoch_admmWrapper import Stoch_AdmmWrapper
+
+from test_farmer_ef_ph import scipy_ef_solve
+
+
+def _ph(b, rho=1.0, iters=150, conv=1e-3, windows=8, tol=1e-7):
+    opts = ph_mod.PHOptions(
+        default_rho=rho, max_iterations=iters, conv_thresh=conv,
+        subproblem_windows=windows,
+        pdhg=pdhg.PDHGOptions(tol=tol, restart_period=40))
+    algo = ph_mod.PH(opts, b)
+    return algo, algo.ph_main()
+
+
+# ---------------- apl1p ----------------
+
+def _apl1p_specs(num=6):
+    return [apl1p.scenario_creator(nm, num_scens=num)
+            for nm in apl1p.scenario_names_creator(num)]
+
+
+def test_apl1p_sampling_matches_reference_stream():
+    # the reference draws rand(6) from RandomState(scennum): indices
+    # 1-2 pick availability, 3-5 demand — spot-check determinism + range
+    a0, d0 = apl1p.sample(0)
+    a0b, d0b = apl1p.sample(0)
+    assert np.array_equal(a0, a0b) and np.array_equal(d0, d0b)
+    assert all(v in (1.0, 0.9, 0.5, 0.1) for v in [a0[0]])
+    assert all(v in (1.0, 0.9, 0.7, 0.1, 0.0) for v in [a0[1]])
+    assert all(v in (900.0, 1000.0, 1100.0, 1200.0) for v in d0)
+    # different scenarios differ somewhere
+    draws = [apl1p.sample(i) for i in range(8)]
+    assert len({tuple(np.concatenate(dr)) for dr in draws}) > 1
+
+
+def test_apl1p_ef_matches_scipy():
+    specs = _apl1p_specs(6)
+    sobj, sx = scipy_ef_solve(specs)
+    ef = ef_mod.ExtensiveForm(
+        {"tol": 1e-7, "max_iters": 300_000},
+        apl1p.scenario_names_creator(6), apl1p.scenario_creator,
+        {"num_scens": 6})
+    st = ef.solve_extensive_form()
+    assert bool(st.done.all())
+    assert ef.get_objective_value() == pytest.approx(sobj, rel=2e-3)
+
+
+def test_apl1p_ph_brackets_ef():
+    specs = _apl1p_specs(6)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=2.0, iters=200, conv=1e-2)
+    assert tb <= sobj + abs(sobj) * 1e-3   # wait-and-see lower bound
+    assert conv <= 1e-2
+
+
+# ---------------- gbd ----------------
+
+def _gbd_specs(num=5):
+    return [gbd.scenario_creator(nm, num_scens=num)
+            for nm in gbd.scenario_names_creator(num)]
+
+
+def test_gbd_demand_distributions():
+    dmds, prbs = gbd._distributions(None)
+    for d, p in zip(dmds, prbs):
+        assert len(d) == len(p)
+        assert np.isclose(np.sum(p), 1.0, atol=1e-6)
+    d0 = gbd.sample(0)
+    assert all(any(np.isclose(v, dm).any() for dm in [dmds[i]])
+               for i, v in enumerate(d0))
+
+
+def test_gbd_ef_matches_scipy():
+    specs = _gbd_specs(5)
+    sobj, _ = scipy_ef_solve(specs)
+    ef = ef_mod.ExtensiveForm(
+        {"tol": 1e-7, "max_iters": 300_000},
+        gbd.scenario_names_creator(5), gbd.scenario_creator,
+        {"num_scens": 5})
+    st = ef.solve_extensive_form()
+    assert bool(st.done.all())
+    assert ef.get_objective_value() == pytest.approx(sobj, rel=2e-3)
+
+
+def test_gbd_ph_converges():
+    specs = _gbd_specs(5)
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=5.0, iters=250, conv=1e-2)
+    assert tb <= sobj + abs(sobj) * 1e-3
+    assert conv <= 1e-2
+    # first stage is a genuine allocation: inventory rows hold at xbar
+    x1 = algo.first_stage_solution()
+    x = x1.reshape(4, 5)
+    slackless_use = x.sum(axis=1)
+    assert np.all(slackless_use <= np.array([10, 19, 25, 15]) + 1e-2)
+
+
+# ---------------- stoch_distr ----------------
+
+def test_stoch_distr_admm_matches_global_lp():
+    R, S = 3, 3
+    data = distr.region_data(R, seed=2)
+    stoch_names = stoch_distr.stoch_scenario_names_creator(S)
+    cons = stoch_distr.consensus_vars_creator(R, data)
+    wrapper = Stoch_AdmmWrapper(
+        {}, stoch_distr.admm_subproblem_names_creator(R), stoch_names,
+        lambda snm, rnm, **kw: stoch_distr.scenario_creator(
+            snm, rnm, data=data), cons)
+    b = wrapper.make_batch()
+    assert b.tree.num_stages == 3
+    assert b.num_scenarios == R * S
+    algo, (conv, eobj, tb) = _ph(b, rho=2.0, iters=400, conv=2e-4,
+                                 windows=10)
+    ref = stoch_distr.global_lp_oracle(data, stoch_names)
+    assert conv <= 2e-4
+    # consensus expectation within 1% of the merged two-stage LP
+    assert eobj == pytest.approx(ref, rel=1e-2)
+    # z is a ROOT (stage-1) quantity: one value across all nodes
+    xb = np.asarray(algo.state.xbar_nodes)
+    assert xb.shape[1] == b.num_nonants
