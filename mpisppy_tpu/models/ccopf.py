@@ -1,0 +1,168 @@
+###############################################################################
+# ccopf: multistage (chance-constrained-style) optimal power flow on a
+# scenario tree — the acopf3 family (ref:examples/acopf3/
+# ccopf_multistage.py + ACtree.py + fourstage.py), re-based on the
+# LINEARIZED DC power-flow model (B-theta), the standard compiler-
+# friendly stand-in for the reference's egret AC formulation: the AC
+# physics live in an external nonlinear solver there, which has no
+# TPU-native analog; the decision structure (multistage generation
+# nonants over a tree of demand outcomes, line limits, shed penalties)
+# is preserved.
+#
+# Per scenario (a leaf path of the (bf1, bf2) 3-stage tree):
+#   stage t in {1,2,3}: dispatch g_{t,i}, angles theta_{t,b}, shed
+#   slack u_{t,b} >= 0
+#   rows: bus balance  sum_{i at b} g - sum_l B_l inc(l,b) dtheta = d_b(t)
+#         line limits  |B_l (theta_from - theta_to)| <= cap_l
+#   cost: c2 g^2 + c1 g (QUADRATIC — exercises the q path) + shed
+#   nonants: g at stages 1 and 2 (stage-major, hydro's tree layout).
+# Demand at stages 2/3 scales by seeded per-branch multipliers
+# (ref:ACtree.py's per-node random demand scaling).
+###############################################################################
+from __future__ import annotations
+
+import numpy as np
+
+from mpisppy_tpu.core.batch import ScenarioSpec
+from mpisppy_tpu.core.tree import ScenarioTree
+from mpisppy_tpu.utils.sputils import extract_num
+
+_SHED = 500.0
+
+
+def grid_instance(n_buses: int = 4, seed: int = 0) -> dict:
+    """Small ring grid: one generator per bus except the last, lines
+    ring-connected, quadratic gen costs."""
+    rng = np.random.RandomState(seed)
+    lines = [(b, (b + 1) % n_buses) for b in range(n_buses)]
+    gens = list(range(max(1, n_buses - 1)))
+    return {
+        "n_buses": n_buses,
+        "lines": lines,
+        "B": rng.uniform(5.0, 15.0, size=len(lines)),
+        "cap": rng.uniform(0.6, 1.2, size=len(lines)),
+        "gens": gens,                      # bus index of each generator
+        "gmax": rng.uniform(0.8, 1.6, size=len(gens)),
+        "c1": rng.uniform(10.0, 30.0, size=len(gens)),
+        "c2": rng.uniform(2.0, 6.0, size=len(gens)),
+        "demand": rng.uniform(0.3, 0.7, size=n_buses),
+    }
+
+
+def branch_multiplier(stage: int, branch: int, seed: int = 0) -> float:
+    rng = np.random.RandomState(40_000 + 97 * stage + branch + seed)
+    return float(rng.uniform(0.8, 1.25))
+
+
+def scenario_creator(scenario_name: str, instance: dict | None = None,
+                     branching_factors=(3, 3), seed: int = 0,
+                     **_ignored) -> ScenarioSpec:
+    inst = instance or grid_instance()
+    bfs = tuple(int(b) for b in branching_factors)
+    if len(bfs) != 2:
+        raise ValueError("ccopf is a 3-stage problem: two branching "
+                         "factors (ref:examples/acopf3/fourstage.py is "
+                         "the 4-stage variant of the same tree recipe)")
+    snum = extract_num(scenario_name)
+    b2, b3 = snum // bfs[1], snum % bfs[1]
+    mult = {1: 1.0,
+            2: branch_multiplier(2, b2, seed),
+            3: branch_multiplier(3, b2 * bfs[1] + b3, seed)}
+
+    nb = inst["n_buses"]
+    lines = inst["lines"]
+    gens = inst["gens"]
+    nl, ng = len(lines), len(gens)
+    # per-stage columns: [g (ng), theta (nb), shed (nb)]
+    per = ng + nb + nb
+    n = 3 * per
+
+    def gcol(t, i):
+        return (t - 1) * per + i
+
+    def thcol(t, b):
+        return (t - 1) * per + ng + b
+
+    def ucol(t, b):
+        return (t - 1) * per + ng + nb + b
+
+    c = np.zeros(n)
+    q = np.zeros(n)
+    l = np.full(n, -np.inf)  # noqa: E741
+    u = np.full(n, np.inf)
+    for t in (1, 2, 3):
+        for i in range(ng):
+            c[gcol(t, i)] = inst["c1"][i]
+            q[gcol(t, i)] = 2.0 * inst["c2"][i]  # q is the 1/2 x'Qx diag
+            l[gcol(t, i)] = 0.0
+            u[gcol(t, i)] = inst["gmax"][i]
+        l[thcol(t, 0)] = 0.0     # reference bus
+        u[thcol(t, 0)] = 0.0
+        for b in range(1, nb):
+            l[thcol(t, b)] = -np.pi
+            u[thcol(t, b)] = np.pi
+        for b in range(nb):
+            c[ucol(t, b)] = _SHED
+            l[ucol(t, b)] = 0.0
+            u[ucol(t, b)] = 10.0
+
+    rows, bl, bu = [], [], []
+    for t in (1, 2, 3):
+        d = inst["demand"] * mult[t]
+        for b in range(nb):   # bus balance (equality)
+            r = np.zeros(n)
+            for i, gb in enumerate(gens):
+                if gb == b:
+                    r[gcol(t, i)] = 1.0
+            for li, (f, to) in enumerate(lines):
+                if f == b:
+                    r[thcol(t, f)] -= inst["B"][li]
+                    r[thcol(t, to)] += inst["B"][li]
+                if to == b:
+                    r[thcol(t, to)] -= inst["B"][li]
+                    r[thcol(t, f)] += inst["B"][li]
+            r[ucol(t, b)] = 1.0
+            rows.append(r)
+            bl.append(float(d[b]))
+            bu.append(float(d[b]))
+        for li, (f, to) in enumerate(lines):   # line limits
+            r = np.zeros(n)
+            r[thcol(t, f)] = inst["B"][li]
+            r[thcol(t, to)] = -inst["B"][li]
+            rows.append(r)
+            bl.append(-float(inst["cap"][li]))
+            bu.append(float(inst["cap"][li]))
+
+    nonant_idx = np.concatenate([
+        [gcol(1, i) for i in range(ng)],
+        [gcol(2, i) for i in range(ng)]]).astype(np.int32)
+    return ScenarioSpec(
+        name=scenario_name, c=c, q=q, A=np.asarray(rows),
+        bl=np.asarray(bl), bu=np.asarray(bu), l=l, u=u,
+        nonant_idx=nonant_idx,
+    )
+
+
+def make_tree(branching_factors=(3, 3),
+              instance: dict | None = None) -> ScenarioTree:
+    bfs = tuple(branching_factors)
+    ng = len((instance or grid_instance())["gens"])
+    return ScenarioTree(branching_factors=bfs,
+                        nonants_per_stage=(ng, ng))
+
+
+def scenario_names_creator(num_scens: int, start: int | None = None):
+    start = 0 if start is None else start
+    return [f"scen{i}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+
+
+def kw_creator(cfg):
+    return {}
+
+
+def scenario_denouement(rank, scenario_name, spec, x=None):
+    pass

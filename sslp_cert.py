@@ -70,7 +70,12 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
         print(f"[cert{n_scens}] LP-lag outer {outer:.4f} "
               f"cert={bool(lp_lag.certified)}")
 
-    bopts = bnb.BnBOptions()
+    # Two budgets: INNER-side evaluations only need integer-feasible
+    # incumbents (res.inner is a valid upper bound at any truncation),
+    # so they run light; the OUTER side's bound quality scales with the
+    # per-scenario B&B budget, so it runs heavy.
+    eval_opts = bnb.BnBOptions(max_rounds=60, pool_size=32)
+    lag_opts = bnb.BnBOptions(max_rounds=240)
 
     # -- 4. candidate pool + batched MIP evaluation ------------------------
     x_non = batch.nonants(drv.state.solver.x)
@@ -79,7 +84,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
              np.asarray(xhat_mod.slam_candidate(batch, x_non, True)),
              np.asarray(xhat_mod.slam_candidate(batch, x_non, False))]
     ws = bnb.solve_mip(batch.qp, batch.d_col, np.nonzero(
-        np.asarray(batch.integer_full))[0].astype(np.int32), bopts)
+        np.asarray(batch.integer_full))[0].astype(np.int32), eval_opts)
     ws_x = np.asarray(ws.x)[:, np.asarray(batch.nonant_idx)]
     for s in range(batch.num_real):
         if bool(np.asarray(ws.feasible)[s]):
@@ -91,7 +96,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
         if key not in seen:
             seen.add(key)
             pool.append(c)
-    evs = mip_mod.evaluate_mip_many(batch, pool, bopts)
+    evs = mip_mod.evaluate_mip_many(batch, pool, eval_opts)
     inner, xhat_best = float("inf"), pool[0]
     for e in evs:
         if e["feasible"] and e["value"] < inner:
@@ -101,7 +106,8 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
               f"({time.time() - t_start:.0f}s)")
 
     # -- 5. local search ---------------------------------------------------
-    ls = mip_mod.first_stage_local_search(batch, xhat_best, inner, bopts,
+    ls = mip_mod.first_stage_local_search(batch, xhat_best, inner,
+                                          eval_opts, max_rounds=4,
                                           verbose=verbose)
     inner, xhat_best = ls["value"], ls["xhat"]
     if verbose:
@@ -114,7 +120,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     # -- 6. integer-Lagrangian Polyak ascent -------------------------------
     if ascent_steps > 0 and gap_of(inner, outer) > target_gap:
         asc = mip_mod.mip_dual_ascent_polyak(
-            batch, W, inner, ascent_steps, bopts,
+            batch, W, inner, ascent_steps, lag_opts,
             target=inner - target_gap * max(1.0, abs(inner)),
             verbose=verbose)
         outer = max(outer, asc["bound"])
@@ -129,7 +135,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     # -- 7. decomposition B&B ----------------------------------------------
     if dd_nodes > 0 and gap_of(inner, outer) > target_gap:
         dd = mip_mod.decomposition_bnb(
-            batch, W_best, bopts, max_nodes=dd_nodes,
+            batch, W_best, lag_opts, max_nodes=dd_nodes,
             target_gap=target_gap, inner0=inner, xhat0=xhat_best,
             verbose=verbose)
         inner = min(inner, dd["inner"])

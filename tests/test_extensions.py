@@ -157,13 +157,23 @@ def test_phtracker(tmp_path):
     folder = str(tmp_path / "trk")
     algo = ph_mod.PH(OPTS, farmer_batch(),
                      extensions=functools.partial(
-                         PHTracker, folder=folder, track_nonants=True))
+                         PHTracker, folder=folder, track_nonants=True,
+                         track_duals=True, track_xbars=True,
+                         track_scen_gaps=True, plots=True))
     algo.ph_main()
-    csv = os.path.join(folder, "hub.csv")
-    assert os.path.exists(csv)
-    lines = open(csv).read().strip().splitlines()
-    assert len(lines) >= 2  # header + >=1 iteration
-    assert any(f.endswith(".npz") for f in os.listdir(folder))
+    cyl = os.path.join(folder, "hub")
+    # per-quantity csvs (ref:phtracker.py per-cylinder folder layout)
+    for t in ("convergence", "gaps", "bounds", "nonants", "duals",
+              "xbars", "scen_gaps"):
+        fn = os.path.join(cyl, f"{t}.csv")
+        assert os.path.exists(fn), t
+        lines = open(fn).read().strip().splitlines()
+        assert len(lines) >= 2, t  # header + >=1 iteration
+    # xbars track one value per nonant slot + the iteration column
+    hdr = open(os.path.join(cyl, "xbars.csv")).readline().strip()
+    assert len(hdr.split(",")) == 1 + algo.batch.num_nonants
+    # plots render when matplotlib is present
+    assert os.path.exists(os.path.join(cyl, "convergence.png"))
 
 
 def test_primal_dual_converger():

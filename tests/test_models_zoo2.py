@@ -132,3 +132,86 @@ def test_stoch_distr_admm_matches_global_lp():
     # z is a ROOT (stage-1) quantity: one value across all nodes
     xb = np.asarray(algo.state.xbar_nodes)
     assert xb.shape[1] == b.num_nonants
+
+
+# ---------------- usar ----------------
+
+def test_usar_lp_relax_ef_and_ph():
+    from mpisppy_tpu.models import usar
+    inst = usar.generate_instance(num_depots=3, num_sites=6,
+                                  time_horizon=5, num_active_depots=2,
+                                  seed=1)
+    N = 4
+    specs = [usar.scenario_creator(nm, instance=inst, num_scens=N,
+                                   lp_relax=True)
+             for nm in usar.scenario_names_creator(N)]
+    sobj, _ = scipy_ef_solve(specs)
+    b = batch_mod.from_specs(specs)
+    algo, (conv, eobj, tb) = _ph(b, rho=5.0, iters=250, conv=1e-3)
+    assert tb <= sobj + abs(sobj) * 1e-3 + 1e-6
+    assert conv <= 1e-3
+    # saving lives pays: the optimum is strictly negative
+    assert sobj < -1.0
+
+
+def test_usar_integer_first_stage():
+    from mpisppy_tpu.algos import mip as mip_mod
+    from mpisppy_tpu.models import usar
+    from mpisppy_tpu.ops import bnb
+    inst = usar.generate_instance(num_depots=3, num_sites=5,
+                                  time_horizon=4, num_active_depots=1,
+                                  seed=2)
+    N = 3
+    specs = [usar.scenario_creator(nm, instance=inst, num_scens=N)
+             for nm in usar.scenario_names_creator(N)]
+    b = batch_mod.from_specs(specs)
+    res = mip_mod.certified_mip_gap(
+        b, ph_options=ph_mod.PHOptions(
+            default_rho=5.0, max_iterations=60, conv_thresh=1e-3,
+            pdhg=pdhg.PDHGOptions(tol=1e-6)),
+        opts=bnb.BnBOptions(max_rounds=120), dd_nodes=4)
+    assert np.isfinite(res.inner) and np.isfinite(res.outer)
+    assert res.outer <= res.inner + 1e-6
+    # exactly one active depot in the incumbent
+    depots = np.round(res.xhat[:3])
+    assert depots.sum() == pytest.approx(1.0)
+
+
+# ---------------- ccopf (acopf3 DC stand-in) ----------------
+
+def test_ccopf_lp_ef_matches_scipy_tree():
+    from mpisppy_tpu.models import ccopf
+    from test_hydro import scipy_ef_solve_tree
+    inst = ccopf.grid_instance(4, seed=3)
+    inst["c2"] = np.zeros_like(inst["c2"])   # LP variant for the oracle
+    specs = [ccopf.scenario_creator(nm, instance=inst)
+             for nm in ccopf.scenario_names_creator(9)]
+    tree = ccopf.make_tree((3, 3), inst)
+    sobj, _ = scipy_ef_solve_tree(specs, tree)
+    from mpisppy_tpu.algos import ef as ef_mod2
+    # the B-theta EF is more ill-conditioned than the flow LPs (angle
+    # columns couple through stiff susceptances); 1e-5 relative KKT is
+    # ample for a 3e-3 objective comparison
+    ef = ef_mod2.ExtensiveForm(
+        {"tol": 1e-5, "max_iters": 400_000},
+        ccopf.scenario_names_creator(9), ccopf.scenario_creator,
+        {"instance": inst}, tree=tree)
+    st = ef.solve_extensive_form()
+    assert float(st.score.max()) <= 2e-5
+    assert ef.get_objective_value() == pytest.approx(sobj, rel=3e-3)
+
+
+def test_ccopf_quadratic_ph_converges():
+    from mpisppy_tpu.models import ccopf
+    inst = ccopf.grid_instance(4, seed=3)
+    specs = [ccopf.scenario_creator(nm, instance=inst)
+             for nm in ccopf.scenario_names_creator(9)]
+    tree = ccopf.make_tree((3, 3), inst)
+    b = batch_mod.from_specs(specs, tree=tree)
+    assert float(np.abs(np.asarray(b.qp.q)).max()) > 0.0  # true QP
+    algo, (conv, eobj, tb) = _ph(b, rho=50.0, iters=300, conv=1e-3)
+    assert conv <= 1e-3
+    assert tb <= eobj + abs(eobj) * 1e-3  # wait-and-see brackets
+    # nonant layout: stage-1 + stage-2 generation
+    ng = len(inst["gens"])
+    assert b.num_nonants == 2 * ng
