@@ -312,6 +312,52 @@ class SubgradientOuterBound(OuterBoundSpoke):
         return self.bound
 
 
+class EFOuterBound(OuterBoundSpoke):
+    """Warm PDHG solve of the ASSEMBLED extensive form, publishing its
+    Fenchel-dual value under a dual-residual certificate — an exact
+    outer bound for LP problems where PH's W converges too slowly for
+    the Lagrangian plane (measured on hydro: L(W) plateaus ~3.5% below
+    the LP optimum while the EF dual closes it).  No direct reference
+    analog: the reference gets the equivalent effect from exact solver
+    bestbounds; the EF-as-a-cylinder configuration mirrors its
+    fix-and-solve EF utilities (ref:mpisppy/opt/ef.py:16-155).
+
+    options: 'ef_problem' (algos.ef.EFProblem, required) or
+    'specs' + 'tree' to build one; 'n_windows' per sync (default 20)."""
+
+    converger_spoke_char = "E"
+
+    def __init__(self, opt, options=None):
+        super().__init__(opt, options)
+        efp = self.options.get("ef_problem")
+        if efp is None:
+            from mpisppy_tpu.algos.ef import build_ef
+            efp = build_ef(self.options["specs"],
+                           tree=self.options.get("tree"))
+        self.efp = efp
+        self.n_windows = int(self.options.get("n_windows", 20))
+        self._st = pdhg.init_state(efp.qp, self.pdhg_opts)
+
+    def update(self, hub_payload):
+        self._st = pdhg.solve_fixed(self.efp.qp, self.n_windows,
+                                    self.pdhg_opts, self._st)
+        self._pending = self._st
+
+    def harvest(self):
+        from mpisppy_tpu.ops import boxqp
+        if self._pending is None:
+            return self.bound
+        st = self._pending
+        qp = self.efp.qp
+        dual = float(boxqp.dual_objective(qp, st.x, st.y))
+        _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+        tol = max(self.pdhg_opts.tol, 5.0e-7)
+        if float(rd) <= 10.0 * tol and (self.bound is None
+                                        or dual > self.bound):
+            self.bound = dual
+        return self.bound
+
+
 class FWPHOuterBound(OuterBoundSpoke):
     """FWPH as an outer-bound spoke (ref:cylinders/fwph_spoke.py:11-39):
     self-contained — advances one FWPH outer iteration per hub sync and

@@ -2,21 +2,33 @@
 (VERDICT r3 next #4: close the certified gaps toward <=0.5% with the
 incumbent at the published optimum).
 
-Pipeline per instance (all bounds CERTIFIED, valid for the ORIGINAL
-problem — the y_ij <= x_j strengthening is implied by integrality, so
-the strengthened model has the same integer feasible set and optimum):
+Two models of the SAME integer problem, one per bound side:
 
-  1. build the STRENGTHENED sparse model (models/sslp.py strengthen=True)
-  2. LP PH to convergence -> multipliers W
-  3. certified LP-Lagrangian outer bound at W (seconds — with the VUB
-     rows this alone beats round-3's integer-Lagrangian bound)
-  4. candidate pool: per-scenario wait-and-see MIP first stages +
-     rounded xbar + slam; one batched evaluate_mip_many -> incumbent
-  5. 1-flip local search over the 15 server-open binaries (batched
-     neighbor evaluation) -> improved incumbent
-  6. Polyak-step dual ascent on the INTEGER Lagrangian (batched
-     scenario-MIP solves) -> tighter outer bound
-  7. if still short of target: first-stage decomposition B&B
+  * OUTER bounds run on the VUB-STRENGTHENED model (y_ij <= x_j rows,
+    models/sslp.py strengthen=True).  Validity: with the SIPLIB
+    penalty (1000/unit) far above any revenue, an optimal solution
+    never serves a client from a closed server when any server is open
+    (moving the assignment to an open server pays at most the same
+    overflow penalty while keeping the revenue), and all-closed first
+    stages cost ~penalty * total demand >> optimum — so the VUB cuts
+    remove only suboptimal points and the strengthened optimum EQUALS
+    the original.  Lower bounds for the strengthened problem are
+    therefore valid lower bounds for the original, and its LP
+    relaxation is far tighter (-268 vs -280 on sslp_15_45_5).
+  * INNER bounds run on the ORIGINAL penalty-form model: its recourse
+    is feasible for every first stage (the dummy columns absorb any
+    overflow), so the dive/B&B incumbent search never mistakes a good
+    candidate for infeasible under a truncated budget.
+
+Pipeline per instance (every bound CERTIFIED):
+  1. LP PH on the strengthened model -> multipliers W
+  2. certified LP-Lagrangian outer at W (this alone beats round-3's
+     integer-Lagrangian bound)
+  3. candidate pool (wait-and-see MIP first stages + rounded xbar +
+     slam) -> batched evaluate_mip_many on the ORIGINAL model
+  4. 1-flip local search over the server-open binaries -> incumbent
+  5. Polyak-step dual ascent on the strengthened INTEGER Lagrangian
+  6. if still short of target: first-stage decomposition B&B
 
 Writes SSLP_CERT.json.  Usage:
     python sslp_cert.py [--instances 5,10] [--ascent 12] [--quick]
@@ -45,10 +57,13 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     t_start = time.time()
     dd_dir = ("/root/reference/examples/sslp/data/"
               f"sslp_15_45_{n_scens}/scenariodata")
+    names = sslp.scenario_names_creator(n_scens)
     specs = [sslp.scenario_creator(nm, data_dir=dd_dir, num_scens=n_scens,
-                                   strengthen=True)
-             for nm in sslp.scenario_names_creator(n_scens)]
-    batch = batch_mod.from_specs(specs)
+                                   strengthen=True) for nm in names]
+    batch = batch_mod.from_specs(specs)       # outer plane (tight LP)
+    specs_o = [sslp.scenario_creator(nm, data_dir=dd_dir,
+                                     num_scens=n_scens) for nm in names]
+    batch_inner = batch_mod.from_specs(specs_o)  # inner plane (penalty)
 
     # -- 2. LP PH for W ----------------------------------------------------
     ph_opts = ph_mod.PHOptions(
@@ -70,11 +85,12 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
         print(f"[cert{n_scens}] LP-lag outer {outer:.4f} "
               f"cert={bool(lp_lag.certified)}")
 
-    # Two budgets: INNER-side evaluations only need integer-feasible
-    # incumbents (res.inner is a valid upper bound at any truncation),
-    # so they run light; the OUTER side's bound quality scales with the
-    # per-scenario B&B budget, so it runs heavy.
-    eval_opts = bnb.BnBOptions(max_rounds=60, pool_size=32)
+    # INNER-side evaluations need good integer-feasible incumbents
+    # (res.inner is a valid upper bound at any truncation, but weak
+    # incumbents inflate it — round 3 reached the published optima at
+    # this budget); the OUTER side's bound quality scales with the
+    # per-scenario B&B budget on the strengthened model.
+    eval_opts = bnb.BnBOptions(max_rounds=400)
     lag_opts = bnb.BnBOptions(max_rounds=240)
 
     # -- 4. candidate pool + batched MIP evaluation ------------------------
@@ -83,9 +99,10 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
                                                 drv.state.xbar_nodes[0])),
              np.asarray(xhat_mod.slam_candidate(batch, x_non, True)),
              np.asarray(xhat_mod.slam_candidate(batch, x_non, False))]
-    ws = bnb.solve_mip(batch.qp, batch.d_col, np.nonzero(
-        np.asarray(batch.integer_full))[0].astype(np.int32), eval_opts)
-    ws_x = np.asarray(ws.x)[:, np.asarray(batch.nonant_idx)]
+    ws = bnb.solve_mip(batch_inner.qp, batch_inner.d_col, np.nonzero(
+        np.asarray(batch_inner.integer_full))[0].astype(np.int32),
+        eval_opts)
+    ws_x = np.asarray(ws.x)[:, np.asarray(batch_inner.nonant_idx)]
     for s in range(batch.num_real):
         if bool(np.asarray(ws.feasible)[s]):
             cands.append(np.round(ws_x[s]))
@@ -96,7 +113,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
         if key not in seen:
             seen.add(key)
             pool.append(c)
-    evs = mip_mod.evaluate_mip_many(batch, pool, eval_opts)
+    evs = mip_mod.evaluate_mip_many(batch_inner, pool, eval_opts)
     inner, xhat_best = float("inf"), pool[0]
     for e in evs:
         if e["feasible"] and e["value"] < inner:
@@ -106,7 +123,7 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
               f"({time.time() - t_start:.0f}s)")
 
     # -- 5. local search ---------------------------------------------------
-    ls = mip_mod.first_stage_local_search(batch, xhat_best, inner,
+    ls = mip_mod.first_stage_local_search(batch_inner, xhat_best, inner,
                                           eval_opts, max_rounds=4,
                                           verbose=verbose)
     inner, xhat_best = ls["value"], ls["xhat"]
