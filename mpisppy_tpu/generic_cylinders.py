@@ -48,6 +48,7 @@ def _parse_args(module, args=None):
     cfg.lagranger_args()
     cfg.subgradient_args()
     cfg.xhatxbar_args()
+    cfg.fused_wheel_args()
     cfg.xhatshuffle_args()
     cfg.slama_args()
     cfg.gradient_args()
@@ -147,6 +148,49 @@ def _do_EF(cfg, module):
     print(json.dumps({"EF_objective": obj,
                       "converged": bool(st.done.all())}))
     return ef
+
+
+def _fuse_wheel(cfg, hub, spokes):
+    """Swap the PH hub for FusedPH and the fusable bound spokes
+    (lagrangian / xhatxbar / slam / xhatshuffle) for their fused
+    classes; everything else (cut providers, FWPH, reduced costs, ...)
+    stays a classic spoke on the hub's sync period."""
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.cylinders import spoke as spoke_mod
+
+    fusable = {
+        spoke_mod.LagrangianOuterBound: spoke_mod.FusedLagrangianOuterBound,
+        spoke_mod.XhatXbarInnerBound: spoke_mod.FusedXhatXbarInnerBound,
+        spoke_mod.XhatShuffleInnerBound:
+            spoke_mod.FusedXhatShuffleInnerBound,
+        spoke_mod.SlamMaxHeuristic: spoke_mod.FusedSlamHeuristic,
+        spoke_mod.SlamMinHeuristic: spoke_mod.FusedSlamHeuristic,
+    }
+    present = set()
+    out_spokes = []
+    for sd in spokes:
+        cls = sd["spoke_class"]
+        if cls in fusable:
+            present.add(cls)
+            out_spokes.append({"spoke_class": fusable[cls],
+                               "opt_kwargs": {"options": {}}})
+        else:
+            out_spokes.append(sd)
+    wopts = fw.FusedWheelOptions(
+        lag_windows=8 if spoke_mod.LagrangianOuterBound in present else 0,
+        xhat_windows=4 if spoke_mod.XhatXbarInnerBound in present else 0,
+        slam_windows=2 if (spoke_mod.SlamMaxHeuristic in present
+                           or spoke_mod.SlamMinHeuristic in present)
+        else 0,
+        slam_sense_max=spoke_mod.SlamMinHeuristic not in present,
+        shuffle_windows=4 if spoke_mod.XhatShuffleInnerBound in present
+        else 0,
+        spoke_period=max(1, int(cfg.get("fused_spoke_period", 1) or 1)))
+    hub = dict(hub)
+    hub["opt_class"] = fw.FusedPH
+    hub["opt_kwargs"] = dict(hub.get("opt_kwargs", {}))
+    hub["opt_kwargs"]["wheel_options"] = wopts
+    return hub, out_spokes
 
 
 def _do_decomp(cfg, module):
@@ -261,6 +305,10 @@ def _do_decomp(cfg, module):
         spokes.append(vanilla.slammax_spoke(cfg))
     if cfg.get("slammin"):
         spokes.append(vanilla.slammin_spoke(cfg))
+
+    if cfg.get("fused_wheel") and not cfg.get("lshaped_hub") \
+            and not cfg.get("aph_hub"):
+        hub, spokes = _fuse_wheel(cfg, hub, spokes)
 
     wheel = WheelSpinner(hub, spokes)
     wheel.spin()

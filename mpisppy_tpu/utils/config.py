@@ -195,6 +195,18 @@ class Config:
         self.add_to_config("xhatxbar", "use an xhat-xbar inner spoke",
                            bool, False)
 
+    def fused_wheel_args(self):
+        """TPU-native: run the requested lagrangian/xhatxbar/slam/
+        xhatshuffle planes INSIDE the hub's jitted step
+        (algos/fused_wheel — measured <=5x bare PH vs 642x for
+        separate-dispatch spokes on one chip)."""
+        self.add_to_config("fused_wheel",
+                           "fuse the bound spokes into the hub step",
+                           bool, False)
+        self.add_to_config("fused_spoke_period",
+                           "run fused planes every k-th iteration",
+                           int, 1)
+
     def xhatshuffle_args(self):
         """ref:config.py:676-699."""
         self.add_to_config("xhatshuffle", "use an xhat shuffle spoke",
