@@ -43,7 +43,8 @@ import numpy as np
 
 
 def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
-            target_gap: float = 0.005, verbose: bool = True) -> dict:
+            target_gap: float = 0.005, verbose: bool = True,
+            seed_cands=None) -> dict:
     import jax.numpy as jnp
 
     from mpisppy_tpu.algos import lagrangian as lag_mod
@@ -106,6 +107,11 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     for s in range(batch.num_real):
         if bool(np.asarray(ws.feasible)[s]):
             cands.append(np.round(ws_x[s]))
+    if seed_cands is not None:
+        # externally supplied candidate first stages (e.g. the
+        # LP-ranked leaders of the exhaustive 2^15 enumeration)
+        for c in np.asarray(seed_cands, float):
+            cands.append(c)
     # dedup on the integer signature
     seen, pool = set(), []
     for c in cands:
@@ -179,14 +185,19 @@ def main():
     ap.add_argument("--target-gap", type=float, default=0.005)
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--out", default="SSLP_CERT.json")
+    ap.add_argument("--seed-cands", default=None,
+                    help="npy of (K, 15) candidate first stages to "
+                         "seed the incumbent pool")
     args = ap.parse_args()
     if args.quick:
         args.ascent, args.dd_nodes = 3, 0
+    seeds = None if args.seed_cands is None else np.load(args.seed_cands)
     results = {}
     for inst in args.instances.split(","):
         n = int(inst)
         results[f"sslp_15_45_{n}"] = certify(
-            n, args.ascent, args.dd_nodes, args.target_gap)
+            n, args.ascent, args.dd_nodes, args.target_gap,
+            seed_cands=seeds)
         print(json.dumps({f"sslp_15_45_{n}": results[f"sslp_15_45_{n}"]}))
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
