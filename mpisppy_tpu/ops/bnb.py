@@ -475,11 +475,108 @@ def feasibility_pump(qp: BoxQP, d_col: Array, int_cols: Array,
 # --------------------------------------------------------------------------
 # Dive heuristic: fix-and-round to a full integer assignment.
 # --------------------------------------------------------------------------
+def detect_sos1_groups(qp: BoxQP, d_col: Array, int_cols: Array):
+    """Host-side detection of SOS1-like equality rows: bl == bu, every
+    nonzero coefficient on an INTEGER column, and (in ORIGINAL space)
+    each coefficient equal to the row rhs — i.e. rows of the shape
+    sum_j y_j = h with y binary, h in {0, 1}.  The assignment rows of
+    sslp-type models are exactly this, and independent per-column
+    rounding provably wrecks them (a 0.5/0.5 split client rounds both
+    ways); the dive projects such groups winner-take-all instead.
+
+    Returns (groups (G, L) int32 positions into int_cols padded with
+    -1, active (S, G) bool: rhs ~= coefficient for that scenario) or
+    (None, None) when no groups exist."""
+    A = qp.A
+    if hasattr(A, "vals"):  # ELL: reconstruct rows over int cols
+        vals = np.asarray(A.vals)
+        if vals.ndim == 3:
+            vals = vals[0]
+        cols = np.asarray(A.cols)
+        m, n = A.m, A.n
+        dense = np.zeros((m, n))
+        rows = np.repeat(np.arange(m), cols.shape[1])
+        dense[rows, cols.reshape(-1)] = vals.reshape(-1)
+        A2 = dense
+    else:
+        A2 = np.asarray(A)
+        if A2.ndim == 3:
+            A2 = A2[0]
+    S = qp.c.shape[0]
+    n = qp.c.shape[-1]
+    dcol = np.broadcast_to(np.asarray(d_col), (S, n))[0]
+    bl = np.broadcast_to(np.asarray(qp.bl), (S, qp.m))
+    bu = np.broadcast_to(np.asarray(qp.bu), (S, qp.m))
+    int_cols_np = np.asarray(int_cols)
+    is_int = np.zeros(n, bool)
+    is_int[int_cols_np] = True
+    pos_of = np.full(n, -1, np.int64)
+    pos_of[int_cols_np] = np.arange(len(int_cols_np))
+    eq = np.all(np.abs(bl - bu) <= 1e-9, axis=0)  # equality in every scen
+    groups, actives = [], []
+    # original-space coefficients: A_orig[i, j] * d_row_i = A2[i, j] /
+    # d_col_j ... the row scaling cancels against the scaled rhs, so
+    # compare A2[i, j] / d_col_j (== d_row_i * orig coef) with bl[s, i]
+    # (== d_row_i * orig rhs): equality <=> orig coef == orig rhs.
+    for i in range(qp.m):
+        if not eq[i]:
+            continue
+        nz = np.nonzero(np.abs(A2[i]) > 1e-12)[0]
+        if nz.size < 2 or not np.all(is_int[nz]):
+            continue
+        coefs = A2[i, nz] / dcol[nz]
+        if np.abs(coefs - coefs[0]).max() > 1e-6 * max(
+                1.0, abs(coefs[0])):
+            continue
+        # active where scaled rhs == the common scaled coefficient
+        act = np.abs(bl[:, i] - coefs[0]) <= 1e-6 * max(1.0,
+                                                        abs(coefs[0]))
+        if not act.any():
+            continue
+        groups.append(pos_of[nz])
+        actives.append(act)
+    if not groups:
+        return None, None
+    L = max(len(g) for g in groups)
+    G = len(groups)
+    gm = np.full((G, L), -1, np.int32)
+    for gi, g in enumerate(groups):
+        gm[gi, :len(g)] = g
+    return jnp.asarray(gm), jnp.asarray(np.asarray(actives).T)  # (S, G)
+
+
+def _sos1_project(r: Array, xi: Array, lo: Array, hi: Array,
+                  groups: Array, active: Array) -> Array:
+    """Winner-take-all rounding targets on SOS1 groups: the member with
+    the largest LP value gets 1, the rest 0 (fixed-at-1 members win
+    outright).  r/xi/lo/hi: (S, nI); groups (G, L) padded -1;
+    active (S, G)."""
+    S = r.shape[0]
+    gidx = jnp.where(groups < 0, 0, groups)          # (G, L) safe gather
+    valid = (groups >= 0)[None, :, :]                # (1, G, L)
+    xi_g = xi[:, gidx]                               # (S, G, L)
+    lo_g = lo[:, gidx]
+    hi_g = hi[:, gidx]
+    fixed1 = (lo_g == hi_g) & (lo_g > 0.5) & valid
+    score = jnp.where(valid, xi_g, -jnp.inf)
+    score = jnp.where(fixed1, jnp.inf, score)        # fixed-at-1 wins
+    winner = jnp.argmax(score, axis=-1)              # (S, G)
+    onehot = jax.nn.one_hot(winner, groups.shape[1],
+                            dtype=r.dtype)           # (S, G, L)
+    apply = valid & active[:, :, None]
+    target = jnp.where(apply, onehot, 0.0)
+    # scatter: only APPLIED positions overwrite r
+    r2 = r.at[jnp.arange(S)[:, None, None], gidx].set(
+        jnp.where(apply, target, r[:, gidx]))
+    return r2
+
+
 @partial(jax.jit, static_argnames=("opts", "mode"))
 def dive_round(qp: BoxQP, d_col: Array, int_cols: Array,
                lo: Array, hi: Array, x_warm: Array, y_warm: Array,
                omega: Array, Lnorm: Array,
-               opts: BnBOptions, mode: str = "wave"):
+               opts: BnBOptions, mode: str = "wave",
+               sos1=None):
     """Solve the current partially-fixed LP, then pin integer columns.
 
     mode="wave":   pin up to ~nI/8 CONFIDENT columns (frac <= dive_tol)
@@ -501,22 +598,65 @@ def dive_round(qp: BoxQP, d_col: Array, int_cols: Array,
     fixed = lo == hi
     nI = frac.shape[1]
     S = frac.shape[0]
+    # members of ACTIVE SOS1 groups are resolved ONLY by group mode
+    # (waves confidently pin 0.95-fraction members one by one and the
+    # accumulated picks overload servers — measured +8k objective
+    # blowups on sslp recourse); per-scenario mask since a row can be
+    # active (rhs 1) in one scenario and inactive (rhs 0) in another
+    sos_member = None
+    if sos1 is not None and sos1[0] is not None:
+        groups_, active_ = sos1
+        G_, L_ = groups_.shape
+        gidx_ = jnp.where(groups_ < 0, 0, groups_)
+        valid_ = (groups_ >= 0)
+        membership_ = jnp.zeros((G_, nI), frac.dtype).at[
+            jnp.arange(G_)[:, None], gidx_].max(
+            valid_.astype(frac.dtype))
+        sos_member = (active_.astype(frac.dtype) @ membership_) > 0.5
+
     if mode == "final":
         newfix = ~fixed
+    elif mode == "group":
+        # pin ONE whole SOS1 group per re-solve (the one with the
+        # clearest winner): mass-pinning all groups at their argmax
+        # stacks correlated winners onto the same attractive server
+        # (measured +16k objective blowups); pin-then-resolve lets the
+        # LP steer the remaining clients around the filled capacity
+        groups, active = sos1
+        G, L = groups.shape
+        gidx = jnp.where(groups < 0, 0, groups)
+        valid = (groups >= 0)
+        fixed_g = fixed[:, gidx] & valid[None]
+        unresolved = jnp.any(~fixed_g & valid[None], axis=-1) & active
+        xi_g = jnp.where(valid[None], xi[:, gidx], -jnp.inf)
+        conf = jnp.max(jnp.where(fixed_g, -jnp.inf, xi_g), axis=-1)
+        conf = jnp.where(unresolved, conf, -jnp.inf)
+        gstar = jnp.argmax(conf, axis=-1)                  # (S,)
+        has = jnp.any(unresolved, axis=-1)
+        membership = jnp.zeros((G, nI), bool).at[
+            jnp.arange(G)[:, None], gidx].max(valid)
+        sel = jax.nn.one_hot(gstar, G, dtype=frac.dtype)   # (S, G)
+        mem = (sel @ membership.astype(frac.dtype)) > 0.5  # (S, nI)
+        newfix = mem & ~fixed & has[:, None]
     elif mode == "single":
-        jstar = jnp.argmin(jnp.where(fixed, jnp.inf, frac), axis=1)
-        has_unfixed = ~jnp.all(fixed, axis=1)
+        blocked = fixed if sos_member is None else (fixed | sos_member)
+        jstar = jnp.argmin(jnp.where(blocked, jnp.inf, frac), axis=1)
+        has_unfixed = ~jnp.all(blocked, axis=1)
         newfix = jax.nn.one_hot(jstar, nI, dtype=bool) \
-            & has_unfixed[:, None]
+            & has_unfixed[:, None] & ~fixed
     else:
         K = max(1, nI // 8)
-        score = jnp.where(fixed, -jnp.inf, -frac)       # bigger = better
+        blocked = fixed if sos_member is None else (fixed | sos_member)
+        score = jnp.where(blocked, -jnp.inf, -frac)     # bigger = better
         vals, idx = jax.lax.top_k(score, K)             # K smallest fracs
         take = vals > -opts.dive_tol                    # confident only
         newfix = jnp.zeros_like(fixed)
         newfix = newfix.at[jnp.arange(S)[:, None], idx].set(take)
         newfix = newfix & ~fixed
     r = jnp.clip(jnp.floor(xi + 0.5), lo, hi)
+    if sos1 is not None and sos1[0] is not None:
+        groups, active = sos1
+        r = jnp.clip(_sos1_project(r, xi, lo, hi, groups, active), lo, hi)
     lo2 = jnp.where(newfix, r, lo)
     hi2 = jnp.where(newfix, r, hi)
     feasible = (rp <= opts.feas_tol) & (sol.status != pdhg.INFEASIBLE) \
@@ -565,29 +705,40 @@ def dive(qp: BoxQP, d_col: Array, int_cols: Array,
     def all_fixed():
         return bool(np.all(np.asarray(lo) == np.asarray(hi)))
 
+    # SOS1-like assignment rows round winner-take-all (detected once)
+    sos1 = detect_sos1_groups(qp, d_col, int_cols)
+
     prev_fixed = -1
     for _ in range(max(1, opts.dive_rounds)):
         lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
             qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
-            opts, "wave")
+            opts, "wave", sos1=sos1)
         nfixed = int((np.asarray(lo) == np.asarray(hi)).sum())
         if all_fixed() or nfixed == prev_fixed:  # no confident cols left
             break
         prev_fixed = nfixed
+    # SOS1 groups: one whole group per re-solve, clearest winner first
+    if sos1[0] is not None:
+        for _ in range(int(sos1[0].shape[0])):
+            if all_fixed():
+                break
+            lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
+                qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega,
+                Lnorm, opts, "group", sos1=sos1)
     # ambiguous tail: one pin per re-solve
     for _ in range(opts.dive_tail):
         if all_fixed():
             break
         lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
             qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
-            opts, "single")
+            opts, "single", sos1=sos1)
     # pin any remainder, then one last solve of the fully fixed LP
     lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
         qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
-        opts, "final")
+        opts, "final", sos1=sos1)
     lo, hi, x_warm, y_warm, omega, obj, feas = dive_round(
         qp, d_col, int_cols, lo, hi, x_warm, y_warm, omega, Lnorm,
-        opts, "final")
+        opts, "final", sos1=sos1)
     value = jnp.where(feas, obj, jnp.inf)
     x_orig = x_warm * jnp.broadcast_to(d_col, x_warm.shape)
     return value, x_orig, feas, (x_warm, y_warm, omega, Lnorm)

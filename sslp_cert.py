@@ -179,6 +179,10 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) — the env var "
+                         "is ignored when the axon TPU plugin is on the "
+                         "path, only the config API works")
     ap.add_argument("--instances", default="5,10")
     ap.add_argument("--ascent", type=int, default=12)
     ap.add_argument("--dd-nodes", type=int, default=20)
@@ -189,6 +193,9 @@ def main():
                     help="npy of (K, 15) candidate first stages to "
                          "seed the incumbent pool")
     args = ap.parse_args()
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     if args.quick:
         args.ascent, args.dd_nodes = 3, 0
     seeds = None if args.seed_cands is None else np.load(args.seed_cands)
