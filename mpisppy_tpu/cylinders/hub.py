@@ -14,7 +14,9 @@
 # spokes remain for cut/rc providers and multi-process deployments.
 #
 # Termination semantics match ref:mpisppy/cylinders/hub.py:82-166:
-#   * rel_gap  <= options['rel_gap']   (gap = (inner-outer)/|inner|)
+#   * rel_gap  <= options['rel_gap']   (gap = (inner-outer)/|inner|;
+#     when |inner| ~ 0 the denominator widens to max(|inner|,|outer|)
+#     so shifted-objective models can still terminate — see compute_gaps)
 #   * abs_gap  <= options['abs_gap']
 #   * inner bounds stalled for 'max_stalled_iters' hub iterations
 ###############################################################################
@@ -64,7 +66,19 @@ class Hub(SPCommunicator):
         if self.BestInnerBound in (math.inf, -math.inf):
             rel_gap = math.inf
         else:
-            rel_gap = abs_gap / max(nano, abs(self.BestInnerBound))
+            # Reference semantics: divide by |inner| (ref:hub.py:96-101).
+            # That blows up when the optimal objective is near zero
+            # (legit for shifted models) and rel_gap termination can then
+            # never fire — ONLY in that degenerate case fall back to the
+            # larger bound magnitude, so every normal run keeps the exact
+            # reference gap convention (the one BENCH numbers use).
+            denom = abs(self.BestInnerBound)
+            ob = abs(self.BestOuterBound)
+            near_zero = denom < 1e-6 * max(1.0, ob if math.isfinite(ob)
+                                           else 0.0)
+            if near_zero and math.isfinite(ob):
+                denom = max(denom, ob)
+            rel_gap = abs_gap / max(nano, denom)
         return abs_gap, rel_gap
 
     def determine_termination(self) -> bool:

@@ -3,3 +3,6 @@
 from mpisppy_tpu.extensions.extension import (  # noqa: F401
     Extension, MultiExtension,
 )
+from mpisppy_tpu.extensions.avgminmaxer import MinMaxAvg  # noqa: F401
+from mpisppy_tpu.extensions.diagnoser import Diagnoser  # noqa: F401
+from mpisppy_tpu.extensions.xhatclosest import XhatClosest  # noqa: F401

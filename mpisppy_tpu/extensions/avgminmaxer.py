@@ -1,0 +1,61 @@
+###############################################################################
+# MinMaxAvg (ref:mpisppy/extensions/avgminmaxer.py:16-44): print
+# avg/min/max (and max-min) of a per-scenario component each iteration.
+#
+# The reference resolves options["avgminmax_name"] to a Pyomo component
+# (e.g. "FirstStageCost") per local instance and MPI-reduces; here the
+# component resolves to a per-scenario device vector and the three
+# reductions fuse into one fetch.  Supported component names:
+#   "objective"        — per-scenario objective at the current iterate
+#   "nonant:<k>"       — nonant slot k's per-scenario value
+# (the batched model has no named expression dictionary to look up).
+###############################################################################
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+class MinMaxAvg(Extension):
+    def __init__(self, ph, compstr: str | None = None):
+        super().__init__(ph)
+        self.compstr = compstr \
+            or getattr(ph.options, "avgminmax_name", None) \
+            or "objective"
+
+    def _component(self):
+        st = self.opt.state
+        batch = self.opt.batch
+        if self.compstr.startswith("nonant:"):
+            k = int(self.compstr.split(":", 1)[1])
+            vals = batch.nonants(st.solver.x)[:, k]
+        else:
+            vals = batch.objective(st.solver.x)
+        return vals
+
+    def avg_min_max(self):
+        """(avg, min, max) over real scenarios — the surface of
+        ref PHBase.avg_min_max (ref:phbase.py avg_min_max)."""
+        batch = self.opt.batch
+        vals = self._component()
+        real = batch.p > 0.0
+        avg = self.opt.batch.expectation(vals)
+        vmin = jnp.min(jnp.where(real, vals, jnp.inf))
+        vmax = jnp.max(jnp.where(real, vals, -jnp.inf))
+        out = np.asarray(jnp.stack([avg, vmin, vmax]))  # one fetch
+        return float(out[0]), float(out[1]), float(out[2])
+
+    def _report(self):
+        if self.opt.state is None:
+            return
+        avgv, minv, maxv = self.avg_min_max()
+        print(f"  ###  {self.compstr}: avg, min, max, max-min "
+              f"{avgv} {minv} {maxv} {maxv - minv}")
+
+    def post_iter0(self):
+        self._report()
+
+    def enditer(self):
+        self._report()

@@ -1,0 +1,65 @@
+###############################################################################
+# Diagnoser (ref:mpisppy/extensions/diagnoser.py:21-86): append one
+# diagnostic line per scenario per iteration to
+# `<diagnoser_outdir>/<scenario>.dag` — "iter,objective".
+#
+# The reference loops local Pyomo instances per rank; here the whole
+# (S,) per-scenario objective vector comes back in ONE device fetch per
+# iteration and the host fans it out to the files.  Same refusal to
+# clobber an existing output directory as the reference (which quits);
+# raising is friendlier than quit() for library use.
+###############################################################################
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mpisppy_tpu.extensions.extension import Extension
+
+
+class Diagnoser(Extension):
+    """options come from ph.options.diagnoser_options
+    {"diagnoser_outdir": path} (ref:diagnoser.py:28-40)."""
+
+    def __init__(self, ph, options: dict | None = None):
+        super().__init__(ph)
+        opts = dict(options
+                    or getattr(ph.options, "diagnoser_options", None)
+                    or {})
+        self.dirname = opts.get("diagnoser_outdir", "diagnostics")
+        if os.path.exists(self.dirname):
+            raise RuntimeError(
+                f"Diagnoser: output directory exists: {self.dirname} "
+                "(refusing to clobber, ref:diagnoser.py:29-34)")
+        os.makedirs(self.dirname)
+        self._rows: dict[str, list[str]] = {}
+
+    def write_loop(self):
+        st = self.opt.state
+        if st is None:
+            return
+        batch = self.opt.batch
+        objs = np.asarray(batch.objective(st.solver.x))  # (S,) one fetch
+        it = self.opt._iter
+        for i, name in enumerate(self.opt.scenario_names):
+            # rows buffer in memory (one small string per scenario-iter)
+            # and flush once at post_everything — 10k scenarios x 100s of
+            # iterations of open/append/close triples would gate the host
+            # loop otherwise
+            self._rows.setdefault(name, []).append(f"{it},{objs[i]}\n")
+
+    def _flush(self):
+        for name, rows in self._rows.items():
+            with open(os.path.join(self.dirname, f"{name}.dag"), "a") as f:
+                f.writelines(rows)
+        self._rows.clear()
+
+    def post_iter0(self):
+        self.write_loop()
+
+    def enditer(self):
+        self.write_loop()
+
+    def post_everything(self):
+        self._flush()

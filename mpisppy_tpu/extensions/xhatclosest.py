@@ -1,0 +1,92 @@
+###############################################################################
+# XhatClosest (ref:mpisppy/extensions/xhatclosest.py:16-117): try the
+# scenario whose nonant vector is closest to x̄ — distance is the
+# truncated z-score sum_slots min(3, |x_s - x̄| / stdev) — as the
+# incumbent candidate x̂.
+#
+# The reference scans local scenarios per rank and Allreduces the min
+# distance + winner rank; here the distance is one vectorized (S,N)
+# reduction on device and argmin picks the winner — no communication
+# plane needed.  The variance statistic is the same xsqbar the Fixer
+# uses; it is recomputed here directly from the current iterate so the
+# extension works whether or not PHOptions.compute_xsqbar is on.
+# Evaluation reuses algos.xhat.evaluate (the Xhat_Eval analog), which
+# already carries the stalled-tail rescue pass.
+###############################################################################
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu import global_toc
+from mpisppy_tpu.algos import xhat as xhat_mod
+from mpisppy_tpu.extensions.extension import Extension
+from mpisppy_tpu.ops import pdhg
+
+
+class XhatClosest(Extension):
+    """Closest-scenario-to-x̄ incumbent candidate.
+
+    Options (ph.options.xhat_closest_options when present, else
+    defaults): {"keep_solution": bool} — on True (default) the winning
+    x̂ and its objective stay on the driver as
+    `_xhat_closest_xhat` / `_final_xhat_closest_obj`
+    (ref keeps the solution in the Pyomo instances the same way).
+    """
+
+    def __init__(self, ph, options: dict | None = None):
+        super().__init__(ph)
+        self.options = dict(
+            options
+            or getattr(ph.options, "xhat_closest_options", None)
+            or {})
+        self.keep_solution = bool(self.options.get("keep_solution", True))
+        self._final_xhat_closest_obj = None
+
+    # -- the distance + pick (ref:xhatclosest.py:29-94) -------------------
+    def closest_scenario(self) -> int:
+        st = self.opt.state
+        batch = self.opt.batch
+        x_non = batch.nonants(st.solver.x)              # (S, N)
+        xbar = st.xbar                                  # (S, N)
+        xsqbar, _ = batch.node_average(x_non * x_non)
+        var = xsqbar - xbar * xbar
+        stdev = jnp.sqrt(jnp.maximum(var, 0.0))
+        # slots with no spread contribute 0, matching the reference's
+        # `if variance > 0` guard
+        z = jnp.where(var > 1e-12,
+                      jnp.minimum(3.0, jnp.abs(x_non - xbar)
+                                  / jnp.maximum(stdev, 1e-12)),
+                      0.0)
+        dist = jnp.sum(z, axis=-1)                      # (S,)
+        # padded (probability-0) scenarios can never win
+        dist = jnp.where(batch.p > 0.0, dist, jnp.inf)
+        return int(jnp.argmin(dist))
+
+    def xhat_closest_to_xbar(self, verbose: bool = False):
+        """Returns (obj or None if infeasible, winning scenario name) —
+        the surface of ref:xhatclosest.py:29."""
+        sidx = self.closest_scenario()
+        batch = self.opt.batch
+        x_non = batch.nonants(self.opt.state.solver.x)
+        cand = xhat_mod.round_integers(batch, x_non[sidx])
+        res = xhat_mod.evaluate(batch, cand,
+                                getattr(self.opt.options, "pdhg",
+                                        pdhg.PDHGOptions()))
+        feasible = bool(res.feasible)
+        obj = float(res.value) if feasible else None
+        sname = self.opt.scenario_names[sidx] \
+            if sidx < len(self.opt.scenario_names) else f"scen{sidx}"
+        if verbose:
+            global_toc(f"XhatClosest: scenario {sname} -> "
+                       f"{obj if feasible else 'infeasible'}", True)
+        if feasible and self.keep_solution:
+            self.opt._xhat_closest_xhat = np.asarray(cand)
+        return obj, {"ROOT": sname}
+
+    # -- hooks (ref fires at post_everything) -----------------------------
+    def post_everything(self):
+        obj, _ = self.xhat_closest_to_xbar(
+            verbose=bool(self.options.get("verbose", False)))
+        self._final_xhat_closest_obj = obj
+        self.opt._final_xhat_closest_obj = obj

@@ -154,3 +154,32 @@ def test_spoke_sync_period():
     assert np.isfinite(wheel.BestInnerBound)
     _, rel_gap = wheel.spcomm.compute_gaps()
     assert rel_gap <= 0.05, rel_gap
+
+
+def test_compute_gaps_near_zero_inner():
+    # A shifted model can legitimately have an optimal objective near 0;
+    # the rel_gap denominator must scale by the larger bound magnitude so
+    # termination can still fire (ref divides by |inner| alone).
+    from mpisppy_tpu.cylinders import hub as hub_mod
+
+    h = hub_mod.Hub(opt=None, options={"rel_gap": 0.01})
+    h.BestInnerBound = 1e-12   # ~zero incumbent
+    h.BestOuterBound = -5.0
+    abs_gap, rel_gap = h.compute_gaps()
+    assert abs_gap == pytest.approx(5.0)
+    assert rel_gap == pytest.approx(1.0)  # 5 / max(|1e-12|, |-5|)
+    assert np.isfinite(rel_gap)
+
+    # tight bounds around zero: rel_gap stays finite and of the bounds'
+    # own scale (2x here), not 1e10 as with the |inner|-only denominator
+    h.BestInnerBound = 1e-9
+    h.BestOuterBound = -1e-9
+    _, rel_gap = h.compute_gaps()
+    assert rel_gap == pytest.approx(2.0)
+
+    # EXACT reference semantics whenever |inner| is not degenerate —
+    # the certification convention all BENCH numbers use
+    h.BestInnerBound = -100.0
+    h.BestOuterBound = -101.0
+    abs_gap, rel_gap = h.compute_gaps()
+    assert rel_gap == pytest.approx(1.0 / 100.0)

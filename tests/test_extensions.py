@@ -197,3 +197,57 @@ def test_norm_rho_converger():
     algo = ph_mod.PH(OPTS, farmer_batch(), converger=NormRhoConverger)
     algo.ph_main()
     assert algo.converger_object.conv_value is not None
+
+
+def test_xhat_closest(tmp_path):
+    from mpisppy_tpu.extensions import XhatClosest
+
+    algo = ph_mod.PH(OPTS, farmer_batch(),
+                     extensions=functools.partial(
+                         XhatClosest, options={"keep_solution": True}))
+    algo.ph_main()
+    obj = algo._final_xhat_closest_obj
+    # farmer with a feasible closest-scenario candidate: finite objective
+    # at most trivially below the EF optimum's magnitude scale
+    assert obj is not None and np.isfinite(obj)
+    assert hasattr(algo, "_xhat_closest_xhat")
+    assert algo._xhat_closest_xhat.shape == (algo.batch.num_nonants,)
+    # the incumbent from a feasible candidate upper-bounds the optimum
+    assert obj >= -108390.0 - 1.0
+
+
+def test_diagnoser_writes_files(tmp_path):
+    from mpisppy_tpu.extensions import Diagnoser
+
+    outdir = str(tmp_path / "diag")
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=3,
+                            conv_thresh=0.0, subproblem_windows=4)
+    algo = ph_mod.PH(opts, farmer_batch(),
+                     extensions=functools.partial(
+                         Diagnoser, options={"diagnoser_outdir": outdir}))
+    algo.ph_main()
+    files = sorted(os.listdir(outdir))
+    assert len(files) == 3  # one .dag per scenario
+    lines = open(os.path.join(outdir, files[0])).read().strip().split("\n")
+    assert len(lines) >= 3  # post_iter0 + each enditer
+    it, obj = lines[0].split(",")
+    assert int(it) == 0 and np.isfinite(float(obj))
+    # refuses to clobber an existing directory (ref quits; we raise)
+    with pytest.raises(RuntimeError):
+        Diagnoser(algo, options={"diagnoser_outdir": outdir})
+
+
+def test_minmaxavg(capsys):
+    from mpisppy_tpu.extensions import MinMaxAvg
+
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=3,
+                            conv_thresh=0.0, subproblem_windows=4)
+    algo = ph_mod.PH(opts, farmer_batch(),
+                     extensions=functools.partial(
+                         MinMaxAvg, compstr="objective"))
+    algo.ph_main()
+    out = capsys.readouterr().out
+    assert "###  objective: avg, min, max, max-min" in out
+    ext = algo.extobject
+    avgv, minv, maxv = ext.avg_min_max()
+    assert minv <= avgv <= maxv
