@@ -55,19 +55,45 @@ def _lagrangian_qp(batch: ScenarioBatch, W: Array) -> boxqp.BoxQP:
     return batch.with_nonant_linear_quad(W, zeros)
 
 
-@partial(jax.jit, static_argnames=("opts",))
 def lagrangian_bound(batch: ScenarioBatch, W: Array,
                      opts: pdhg.PDHGOptions = pdhg.PDHGOptions(),
                      solver: pdhg.PDHGState | None = None) -> LagrangianResult:
     """One Lagrangian bound evaluation L(W); valid outer bound when the
     per-node probability-weighted mean of W is ~0 (PH invariant,
-    ref:mpisppy/phbase.py:114-179 Compute_Wbar check)."""
+    ref:mpisppy/phbase.py:114-179 Compute_Wbar check).
+
+    Budgets within dispatch_cap run as ONE jitted program (async — the
+    classic spokes' overlap contract depends on update() not blocking);
+    larger budgets — e.g. the certification pipeline's 100k-iteration
+    evaluations — take a host-level path where pdhg.solve's
+    auto-chunking splits the work into worker-safe dispatches (that
+    path is inherently synchronous).
+    """
+    if not (0 < opts.dispatch_cap < opts.max_iters):
+        return _lagrangian_bound_jit(batch, W, opts, solver)
     qp = _lagrangian_qp(batch, W)
     if solver is None:
         st = pdhg.init_state(qp, opts)
     else:
         st = solver
     st = pdhg.solve(qp, opts, st)
+    return _lagrangian_epilogue(batch, qp, st, opts)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _lagrangian_bound_jit(batch: ScenarioBatch, W: Array,
+                          opts: pdhg.PDHGOptions,
+                          solver: pdhg.PDHGState | None) -> LagrangianResult:
+    qp = _lagrangian_qp(batch, W)
+    st = pdhg.init_state(qp, opts) if solver is None else solver
+    st = pdhg.solve(qp, opts, st)
+    return _lagrangian_epilogue(batch, qp, st, opts)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _lagrangian_epilogue(batch: ScenarioBatch, qp: boxqp.BoxQP,
+                         st: pdhg.PDHGState,
+                         opts: pdhg.PDHGOptions) -> LagrangianResult:
     # Dual value of each subproblem (contains the W·x term implicitly:
     # the qp objective IS f_s + W·x_non in scaled space).
     dual = boxqp.dual_objective(qp, st.x, st.y)

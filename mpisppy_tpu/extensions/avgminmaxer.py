@@ -20,10 +20,12 @@ from mpisppy_tpu.extensions.extension import Extension
 
 class MinMaxAvg(Extension):
     def __init__(self, ph, compstr: str | None = None):
+        # the component name arrives via the constructor kwarg
+        # (functools.partial(MinMaxAvg, compstr=...)); PHOptions is a
+        # frozen dataclass, so there is no ph.options["avgminmax_name"]
+        # channel to read
         super().__init__(ph)
-        self.compstr = compstr \
-            or getattr(ph.options, "avgminmax_name", None) \
-            or "objective"
+        self.compstr = compstr or "objective"
 
     def _component(self):
         st = self.opt.state

@@ -19,15 +19,17 @@ from mpisppy_tpu.extensions.extension import Extension
 
 
 class Diagnoser(Extension):
-    """options come from ph.options.diagnoser_options
-    {"diagnoser_outdir": path} (ref:diagnoser.py:28-40)."""
+    """options arrive via the constructor (wire with
+    functools.partial(Diagnoser, options={"diagnoser_outdir": path,
+    "flush_period": N}) — the ref reads ph.options, but PHOptions is a
+    frozen dataclass here, so the kwarg IS the options channel)."""
 
     def __init__(self, ph, options: dict | None = None):
         super().__init__(ph)
-        opts = dict(options
-                    or getattr(ph.options, "diagnoser_options", None)
-                    or {})
+        opts = dict(options or {})
         self.dirname = opts.get("diagnoser_outdir", "diagnostics")
+        self.flush_period = int(opts.get("flush_period", 20))
+        self._since_flush = 0
         if os.path.exists(self.dirname):
             raise RuntimeError(
                 f"Diagnoser: output directory exists: {self.dirname} "
@@ -44,16 +46,21 @@ class Diagnoser(Extension):
         it = self.opt._iter
         for i, name in enumerate(self.opt.scenario_names):
             # rows buffer in memory (one small string per scenario-iter)
-            # and flush once at post_everything — 10k scenarios x 100s of
-            # iterations of open/append/close triples would gate the host
-            # loop otherwise
+            # and flush periodically — 10k scenarios x 100s of iterations
+            # of per-iteration open/append/close triples would gate the
+            # host loop, but never flushing would lose everything on a
+            # crashed run (the run a diagnoser exists for)
             self._rows.setdefault(name, []).append(f"{it},{objs[i]}\n")
+        self._since_flush += 1
+        if self._since_flush >= self.flush_period:
+            self._flush()
 
     def _flush(self):
         for name, rows in self._rows.items():
             with open(os.path.join(self.dirname, f"{name}.dag"), "a") as f:
                 f.writelines(rows)
         self._rows.clear()
+        self._since_flush = 0
 
     def post_iter0(self):
         self.write_loop()

@@ -27,19 +27,18 @@ from mpisppy_tpu.ops import pdhg
 class XhatClosest(Extension):
     """Closest-scenario-to-x̄ incumbent candidate.
 
-    Options (ph.options.xhat_closest_options when present, else
-    defaults): {"keep_solution": bool} — on True (default) the winning
-    x̂ and its objective stay on the driver as
-    `_xhat_closest_xhat` / `_final_xhat_closest_obj`
+    Options arrive via the constructor — wire with
+    functools.partial(XhatClosest, options={"keep_solution": bool,
+    "verbose": bool}); PHOptions is a frozen dataclass, so the kwarg IS
+    the options channel (the ref reads ph.options["xhat_closest_options"]).
+    On keep_solution=True (default) the winning x̂ and its objective stay
+    on the driver as `_xhat_closest_xhat` / `_final_xhat_closest_obj`
     (ref keeps the solution in the Pyomo instances the same way).
     """
 
     def __init__(self, ph, options: dict | None = None):
         super().__init__(ph)
-        self.options = dict(
-            options
-            or getattr(ph.options, "xhat_closest_options", None)
-            or {})
+        self.options = dict(options or {})
         self.keep_solution = bool(self.options.get("keep_solution", True))
         self._final_xhat_closest_obj = None
 

@@ -62,6 +62,13 @@ class PDHGOptions:
 
     tol: float = 1e-6  # floored at 5*eps of the working dtype at solve time
     max_iters: int = 20_000
+    # Auto-chunking: a single XLA dispatch whose while_loop can run more
+    # than this many iterations is split into multiple capped host
+    # dispatches (the axon TPU worker dies on ~100k-iteration single
+    # dispatches — a library user must not need bench-harness chunking
+    # to be safe).  Only applies to HOST-LEVEL solve() calls; inside a
+    # jit trace the caller owns the budget.  0 disables.
+    dispatch_cap: int = 60_000
     restart_period: int = 40   # candidate-check cadence (iterations)
     omega0: float = 1.0
     power_iters: int = 30
@@ -309,7 +316,13 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
 def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
           state: PDHGState | None = None) -> PDHGState:
     """Solve to tolerance (batch-aware).  Jit-friendly:
-    ``jax.jit(solve, static_argnames='opts')``."""
+    ``jax.jit(solve, static_argnames='opts')``.
+
+    Host-level calls with max_iters > dispatch_cap are automatically
+    split into multiple capped dispatches (see PDHGOptions.dispatch_cap);
+    traced calls keep the single while_loop — a jit caller owns its
+    budget.
+    """
     if state is None:
         st = init_state(p, opts)
     else:
@@ -326,10 +339,38 @@ def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
             status=jnp.zeros_like(state.status),
         )
 
+    traced = isinstance(p.c, jax.core.Tracer)
+    if (not traced and 0 < opts.dispatch_cap < opts.max_iters):
+        while True:
+            st = _dispatch_capped(p, opts, st)
+            if int(st.k) >= opts.max_iters or bool(jnp.all(st.done)):
+                return st
+
     def cond(s):
         return (s.k < opts.max_iters) & ~jnp.all(s.done)
 
     return jax.lax.while_loop(cond, lambda s: _window(p, s, opts), st)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _solve_capped_jit(p: BoxQP, opts: PDHGOptions,
+                      st: PDHGState) -> PDHGState:
+    """One capped dispatch: at most dispatch_cap MORE iterations past the
+    entry count st.k (which persists across chunks, so restart windows
+    and omega adaptation carry over seamlessly)."""
+    k0 = st.k
+
+    def cond(s):
+        return (s.k < opts.max_iters) & ((s.k - k0) < opts.dispatch_cap) \
+            & ~jnp.all(s.done)
+
+    return jax.lax.while_loop(cond, lambda s: _window(p, s, opts), st)
+
+
+def _dispatch_capped(p, opts, st):
+    """Host seam for the auto-chunk loop (monkeypatchable in tests to
+    observe dispatch granularity)."""
+    return _solve_capped_jit(p, opts, st)
 
 
 def solve_fixed(p: BoxQP, n_windows: int, opts: PDHGOptions,

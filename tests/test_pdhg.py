@@ -138,3 +138,73 @@ def test_solve_fixed_budget_runs():
     x = np.asarray(st.x) * sc.d_col
     obj = float(np.asarray(prob.c) @ x)
     assert obj == pytest.approx(res.fun, rel=1e-2, abs=1e-2)
+
+
+def test_auto_chunked_dispatch(monkeypatch):
+    """A host-level solve whose budget exceeds dispatch_cap must split
+    into multiple capped dispatches (the TPU-worker crash guard that
+    round 4 hand-rolled in the bench harness, now in the kernel)."""
+    # constraint-infeasible LP (x >= 2 inside [0,1]) with infeasibility
+    # detection off: the solve can never set done, so it must burn the
+    # whole budget — deterministically exercising the chunk loop
+    f = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    scaled = boxqp.BoxQP(c=f([1.0]), q=f([0.0]), A=f([[1.0]]),
+                         bl=f([2.0]), bu=f([np.inf]),
+                         l=f([0.0]), u=f([1.0]))
+
+    calls = []
+    real = pdhg._dispatch_capped
+
+    def spy(p, opts, st):
+        out = real(p, opts, st)
+        calls.append(int(out.k))
+        return out
+
+    monkeypatch.setattr(pdhg, "_dispatch_capped", spy)
+    opts = pdhg.PDHGOptions(tol=1e-30, max_iters=2_000,
+                            dispatch_cap=400, restart_period=40,
+                            detect_infeas=False)
+    st = pdhg.solve(scaled, opts)
+    # every dispatch advanced at most cap (+one window of slack)
+    assert len(calls) >= 2, calls
+    prev = 0
+    for k in calls:
+        assert k - prev <= opts.dispatch_cap + opts.restart_period
+        prev = k
+    assert int(st.k) <= opts.max_iters
+
+    # traced calls keep the single while_loop: jit of solve with a
+    # huge budget must not host-chunk (the caller owns the budget)
+    calls.clear()
+    jitted = jax.jit(pdhg.solve, static_argnames=("opts",))
+    jitted(scaled, opts).k.block_until_ready()
+    assert calls == []
+
+
+def test_lagrangian_big_budget_chunks(monkeypatch):
+    """lagrangian_bound with a certification-scale budget goes through
+    the capped host seam (sslp_cert's 100k-iteration calls)."""
+    from mpisppy_tpu.algos import lagrangian as lag_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    W = jnp.zeros((batch.num_scenarios, batch.num_nonants),
+                  batch.qp.c.dtype)
+
+    calls = []
+    real = pdhg._dispatch_capped
+
+    def spy(p, opts, st):
+        out = real(p, opts, st)
+        calls.append(int(out.k))
+        return out
+
+    monkeypatch.setattr(pdhg, "_dispatch_capped", spy)
+    res = lag_mod.lagrangian_bound(
+        batch, W, pdhg.PDHGOptions(tol=1e-30, max_iters=100_000,
+                                   dispatch_cap=200))
+    assert len(calls) >= 2, calls
+    assert np.isfinite(float(res.bound))
