@@ -69,25 +69,22 @@ def lagrangian_bound(batch: ScenarioBatch, W: Array,
     auto-chunking splits the work into worker-safe dispatches (that
     path is inherently synchronous).
     """
-    if not (0 < opts.dispatch_cap < opts.max_iters):
+    if not pdhg.will_chunk(opts):
         return _lagrangian_bound_jit(batch, W, opts, solver)
-    qp = _lagrangian_qp(batch, W)
-    if solver is None:
-        st = pdhg.init_state(qp, opts)
-    else:
-        st = solver
-    st = pdhg.solve(qp, opts, st)
-    return _lagrangian_epilogue(batch, qp, st, opts)
+    return _lagrangian_bound_impl(batch, W, opts, solver)
 
 
-@partial(jax.jit, static_argnames=("opts",))
-def _lagrangian_bound_jit(batch: ScenarioBatch, W: Array,
-                          opts: pdhg.PDHGOptions,
-                          solver: pdhg.PDHGState | None) -> LagrangianResult:
+def _lagrangian_bound_impl(batch: ScenarioBatch, W: Array,
+                           opts: pdhg.PDHGOptions,
+                           solver: pdhg.PDHGState | None) -> LagrangianResult:
     qp = _lagrangian_qp(batch, W)
     st = pdhg.init_state(qp, opts) if solver is None else solver
     st = pdhg.solve(qp, opts, st)
     return _lagrangian_epilogue(batch, qp, st, opts)
+
+
+_lagrangian_bound_jit = partial(jax.jit, static_argnames=("opts",))(
+    _lagrangian_bound_impl)
 
 
 @partial(jax.jit, static_argnames=("opts",))

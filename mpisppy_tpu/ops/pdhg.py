@@ -313,6 +313,15 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     return dataclasses.replace(st, k=st.k + opts.restart_period)
 
 
+def will_chunk(opts: PDHGOptions) -> bool:
+    """True when a host-level solve() with these options auto-chunks.
+    Shared predicate so wrappers that pick a jitted fast path (e.g.
+    lagrangian_bound) can never disagree with solve() about chunk
+    eligibility — disagreement would reintroduce the oversized single
+    dispatch the cap exists to prevent."""
+    return 0 < opts.dispatch_cap < opts.max_iters
+
+
 def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
           state: PDHGState | None = None) -> PDHGState:
     """Solve to tolerance (batch-aware).  Jit-friendly:
@@ -339,8 +348,12 @@ def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
             status=jnp.zeros_like(state.status),
         )
 
-    traced = isinstance(p.c, jax.core.Tracer)
-    if (not traced and 0 < opts.dispatch_cap < opts.max_iters):
+    # a call is host-level only when NOTHING is traced — a concrete qp
+    # with a traced state (vmap/jit over state with a captured problem)
+    # must keep the in-trace while_loop
+    traced = any(isinstance(leaf, jax.core.Tracer)
+                 for leaf in jax.tree_util.tree_leaves((p, st)))
+    if not traced and will_chunk(opts):
         while True:
             st = _dispatch_capped(p, opts, st)
             if int(st.k) >= opts.max_iters or bool(jnp.all(st.done)):
