@@ -32,6 +32,40 @@ import numpy as np
 
 Array = jax.Array
 
+# MXU pass count for solver matvecs.  f32 inputs on TPU decompose into
+# bf16 passes: DEFAULT=1 (too coarse for PDHG — stalls ~1e-2 KKT),
+# HIGH=3 (bf16x3, relative error ~4e-6 per matvec, measured on v5e),
+# HIGHEST=6 (bf16x6, full f32).  Read at trace time; set BEFORE
+# building jitted programs via set_matvec_precision().
+MATVEC_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def as_precision(p):
+    """'high' / 'highest' / jax.lax.Precision / None -> Precision|None.
+    The single parser for every precision knob (module default, PDHG
+    iter_precision, the Pallas kernel) so aliases/validation live in
+    one place."""
+    if p is None or isinstance(p, jax.lax.Precision):
+        return p
+    return getattr(jax.lax.Precision, p.upper())
+
+
+def set_matvec_precision(p) -> None:
+    """Set the matvec MXU precision ('high' / 'highest' or a
+    jax.lax.Precision).  Captured at trace time by every solver program;
+    call before the first jit of the run (changing it later leaves
+    already-compiled programs at the old setting).
+
+    WARNING: this default governs EVERYTHING, including KKT residual
+    scoring and convergence tests.  Lowering it below HIGHEST lowers the
+    achievable KKT floor (HIGH floors at ~1e-5..1e-6 relative, measured
+    on sslp-family LPs), so solves with a tighter `tol` will burn
+    max_iters without ever certifying done.  To speed up ONLY the
+    iteration matvecs while keeping scoring exact — the safe choice —
+    use PDHGOptions.iter_precision instead of this setter."""
+    global MATVEC_PRECISION
+    MATVEC_PRECISION = as_precision(p)
+
 
 @partial(
     jax.tree_util.register_dataclass,
@@ -72,32 +106,40 @@ class BoxQP:
     def nbatch(self) -> int:
         return self.c.shape[0] if self.batched else 1
 
-    def matvec(self, x: Array) -> Array:
+    def matvec(self, x: Array, precision=None) -> Array:
         """A @ x, batch-aware (A may be shared across the batch, and may
         be an ops.sparse.EllMatrix for sparse constraint matrices).
 
-        Precision=HIGHEST: TPU matmuls default to bf16 passes, whose
-        ~8-bit mantissa stalls PDHG around 1e-2 relative KKT residual —
-        verified on-chip.  HIGHEST (3-pass bf16) restores f32-accurate
-        accumulation on the MXU at modest cost; convergence depends on it."""
+        Precision: TPU matmuls default to single-pass bf16, whose ~8-bit
+        mantissa stalls PDHG around 1e-2 relative KKT residual — verified
+        on-chip.  `precision=None` uses the module default
+        MATVEC_PRECISION (see set_matvec_precision), a multi-pass bf16
+        scheme that restores near-f32 accumulation on the MXU; hot loops
+        may pass a cheaper explicit precision (the PDHG iteration body
+        runs 3-pass HIGH while restart scoring stays at the default —
+        see PDHGOptions.iter_precision).
+
+        The sparse (EllMatrix) path ignores `precision` by design: its
+        gather-based matvec runs exact f32 FMAs on the VPU — already
+        more accurate than any MXU bf16 pass scheme."""
+        prec = MATVEC_PRECISION if precision is None else precision
         if hasattr(self.A, "matvec"):
             return self.A.matvec(x)
         if self.A.ndim == x.ndim + 1:
             return jnp.einsum("...mn,...n->...m", self.A, x,
-                              precision=jax.lax.Precision.HIGHEST)
+                              precision=prec)
         # shared A with batched x
-        return jnp.einsum("mn,...n->...m", self.A, x,
-                          precision=jax.lax.Precision.HIGHEST)
+        return jnp.einsum("mn,...n->...m", self.A, x, precision=prec)
 
-    def rmatvec(self, y: Array) -> Array:
+    def rmatvec(self, y: Array, precision=None) -> Array:
         """A.T @ y, batch-aware (precision: see matvec)."""
+        prec = MATVEC_PRECISION if precision is None else precision
         if hasattr(self.A, "rmatvec"):
             return self.A.rmatvec(y)
         if self.A.ndim == y.ndim + 1:
             return jnp.einsum("...mn,...m->...n", self.A, y,
-                              precision=jax.lax.Precision.HIGHEST)
-        return jnp.einsum("mn,...m->...n", self.A, y,
-                          precision=jax.lax.Precision.HIGHEST)
+                              precision=prec)
+        return jnp.einsum("mn,...m->...n", self.A, y, precision=prec)
 
 
 def make_boxqp(c, A, bl, bu, l, u, q=None, dtype=jnp.float32) -> BoxQP:  # noqa: E741

@@ -84,6 +84,18 @@ class PDHGOptions:
     # 100k-scenario HBM-bandwidth fix, ops/pdhg_pallas.py — else the
     # XLA fori_loop); True/False forces.
     use_pallas: bool | None = None
+    # scenario-tile height for the Pallas window kernel; larger tiles
+    # lift MXU utilization (bigger GEMM M dim, fewer grid steps) until
+    # the tile's solver state outgrows VMEM
+    pallas_tile_s: int = 128
+    # MXU precision for the ITERATION matvecs only (restart candidate
+    # scoring and convergence tests always run at the boxqp module
+    # default, HIGHEST = 6-pass bf16, so a cheaper iteration precision
+    # can never mis-certify a solution).  None = module default;
+    # "high" = 3-pass bf16, ~2x MXU throughput, measured on-chip to
+    # reach ~1e-6 relative KKT on sslp-family LPs when scoring stays
+    # exact.  See ops/boxqp.py MATVEC_PRECISION.
+    iter_precision: str | None = None
 
 
 @partial(
@@ -174,13 +186,19 @@ def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
     )
 
 
-def _pdhg_iter(p: BoxQP, st: PDHGState, tau: Array, sigma: Array) -> PDHGState:
+def _iter_precision(opts: PDHGOptions):
+    from mpisppy_tpu.ops.boxqp import as_precision
+    return as_precision(opts.iter_precision)
+
+
+def _pdhg_iter(p: BoxQP, st: PDHGState, tau: Array, sigma: Array,
+               precision=None) -> PDHGState:
     """One PDHG step; frozen for problems already `done`."""
     t = tau[..., None]
     s = sigma[..., None]
-    v = st.x - t * p.rmatvec(st.y)
+    v = st.x - t * p.rmatvec(st.y, precision=precision)
     x1 = jnp.clip((v - t * p.c) / (1.0 + t * p.q), p.l, p.u)
-    w = st.y + s * p.matvec(2.0 * x1 - st.x)
+    w = st.y + s * p.matvec(2.0 * x1 - st.x, precision=precision)
     y1 = w - s * jnp.clip(w / s, p.bl, p.bu)
     keep = st.done[..., None]
     x1 = jnp.where(keep, st.x, x1)
@@ -302,12 +320,14 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
         interp = jax.default_backend() != "tpu"
         x, y, xs, ys = pdhg_pallas.run_window(
             p, st.x, st.y, st.x_sum, st.y_sum, tau, sigma, st.done,
-            opts.restart_period, interpret=interp)
+            opts.restart_period, tile_s=opts.pallas_tile_s,
+            precision=opts.iter_precision, interpret=interp)
         st = dataclasses.replace(st, x=x, y=y, x_sum=xs, y_sum=ys)
     else:
+        prec = _iter_precision(opts)
         st = jax.lax.fori_loop(
             0, opts.restart_period,
-            lambda _, s: _pdhg_iter(p, s, tau, sigma), st)
+            lambda _, s: _pdhg_iter(p, s, tau, sigma, prec), st)
     st = dataclasses.replace(st, nwin=st.nwin + opts.restart_period)
     st = _restart(p, st, opts)
     return dataclasses.replace(st, k=st.k + opts.restart_period)

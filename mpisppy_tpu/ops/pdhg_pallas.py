@@ -52,50 +52,134 @@ def _pad_last(x: Array, size: int, value: float) -> Array:
     return jnp.pad(x, cfg, constant_values=value)
 
 
-def _window_kernel(n_iters: int,
-                   tau_ref, sigma_ref, done_ref,
-                   c_ref, q_ref, l_ref, u_ref, bl_ref, bu_ref,
-                   A_ref, AT_ref,
-                   x0_ref, y0_ref, xs0_ref, ys0_ref,
-                   x_ref, y_ref, xs_ref, ys_ref):
+def _split_bf16(v):
+    """Error-free-ish split v ~= hi + lo with hi, lo in bf16.
+
+    The rounded value is materialized via lax.reduce_precision, NOT an
+    astype round-trip: XLA's simplifier folds convert(convert(v, bf16),
+    f32) back to v, which silently zeroes the lo term (measured on v5e:
+    the 3-pass product degraded to 1-pass accuracy).  reduce_precision
+    is the documented escape hatch the simplifier must honor."""
+    rounded = jax.lax.reduce_precision(v, exponent_bits=8, mantissa_bits=7)
+    hi = rounded.astype(jnp.bfloat16)
+    lo = (v - rounded).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _split_bf16_kernel(v):
+    """In-kernel variant of _split_bf16: Mosaic has no reduce_precision
+    lowering, but it also lowers convert ops literally (no XLA-style
+    algebraic folding of the f32->bf16->f32 round trip — verified on
+    v5e by comparing one-iteration kernel output against the exact
+    path), so the plain astype round trip is safe HERE and only here."""
+    hi = v.astype(jnp.bfloat16)
+    lo = (v - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _dot3(v_split, M_hi, M_lo):
+    """bf16x3 matmul: 3 single-pass bf16 MXU dots with f32 accumulation
+    (hi*hi + hi*lo + lo*hi), matching jax.lax.Precision.HIGH semantics —
+    which Mosaic does not accept natively ("Unsupported dot precision:
+    HIGH", measured on v5e), hence the manual decomposition.  Half the
+    MXU passes of HIGHEST; accuracy suffices for INEXACT hot-loop
+    windows only (restart scoring outside the kernel stays exact).
+
+    `v_split` is a (hi, lo) pair from _split_bf16 (XLA callers) or
+    _split_bf16_kernel (inside the Mosaic kernel) — the split must
+    happen at the call site because the two compilers need different
+    round-trip idioms (see those docstrings)."""
+    dims = (((1,), (0,)), ((), ()))
+    v_hi, v_lo = v_split
+    acc = jax.lax.dot_general(v_hi, M_hi, dims,
+                              preferred_element_type=jnp.float32)
+    acc += jax.lax.dot_general(v_hi, M_lo, dims,
+                               preferred_element_type=jnp.float32)
+    acc += jax.lax.dot_general(v_lo, M_hi, dims,
+                               preferred_element_type=jnp.float32)
+    return acc
+
+
+def _window_kernel(n_iters: int, precision, *refs):
     """All n_iters PDHG iterations for one scenario tile, VMEM-resident.
 
-    Math is bit-for-bit the XLA path (ops/pdhg.py _pdhg_iter):
+    Math matches the XLA path (ops/pdhg.py _pdhg_iter) up to float
+    reassociation (loop invariants are hoisted here, see below):
         v  = x - tau * A'y
         x1 = clip((v - tau c) / (1 + tau q), l, u)
         w  = y + sigma * A (2 x1 - x)
         y1 = w - sigma * clip(w / sigma, bl, bu)
     with `done` scenarios frozen and window sums accumulated.
     """
-    hp = jax.lax.Precision.HIGHEST
-    tau = tau_ref[:]          # (T, 1)
-    sigma = sigma_ref[:]
+    three_pass = precision == jax.lax.Precision.HIGH
+    # matrix refs are present only for the precision mode in use (2 for
+    # a single-dot mode, 4 for the bf16x3 split) — dead operands would
+    # cost a DMA + VMEM residency per grid step
+    nmat = 4 if three_pass else 2
+    (tau_ref, sigma_ref, done_ref,
+     c_ref, q_ref, l_ref, u_ref, bl_ref, bu_ref) = refs[:9]
+    mat_refs = refs[9:9 + nmat]
+    (x0_ref, y0_ref, xs0_ref, ys0_ref,
+     x_ref, y_ref, xs_ref, ys_ref) = refs[9 + nmat:]
+
     live = 1.0 - done_ref[:]  # (T, 1) 1.0 = still running
+    # Done-masking folds into the step sizes: with tau = sigma = 0 the
+    # iteration is an exact no-op (x1 = clip(x, l, u) = x since every
+    # iterate is box-feasible; y1 = w - clip(w, 0, 0) = y), so frozen
+    # scenarios need no blend passes — they still accumulate their
+    # frozen iterate into the window sums, matching the XLA path.
+    tau = tau_ref[:] * live   # (T, 1)
+    sigma = sigma_ref[:] * live
     c = c_ref[:]
     q = q_ref[:]
     l = l_ref[:]              # noqa: E741  (T|1, n)
     u = u_ref[:]
     bl = bl_ref[:]
     bu = bu_ref[:]
-    A = A_ref[:]              # (m, n)
-    AT = AT_ref[:]            # (n, m)
+    # Loop-invariant precomputes: the VPU, not the MXU, bounds this
+    # kernel at bench shapes (measured: 6->3 MXU passes bought only
+    # ~15%), and per-element divides are its costliest ops.  Hoisting
+    # removes both in-loop divides and two multiplies per element.
+    tc = tau * c              # (T, n)
+    pre = 1.0 / (1.0 + tau * q)
+    sbl = sigma * bl          # (T, m): sigma*clip(w/sigma,bl,bu)
+    sbu = sigma * bu          # == clip(w, sigma*bl, sigma*bu)
+    # rmv/mv follow BoxQP naming: rmv(y) = A'y (BoxQP.rmatvec),
+    # mv(v) = A v (BoxQP.matvec) — contracting with the (m, n) block
+    # computes A'y, with the (n, m) block computes A v.
+    if three_pass:
+        A_hi = mat_refs[0][:]     # (m, n) bf16
+        AT_hi = mat_refs[1][:]    # (n, m) bf16
+        A_lo = mat_refs[2][:]
+        AT_lo = mat_refs[3][:]
+
+        def rmv(v, _A=A_hi, _Al=A_lo):
+            return _dot3(_split_bf16_kernel(v), _A, _Al)
+
+        def mv(v, _AT=AT_hi, _ATl=AT_lo):
+            return _dot3(_split_bf16_kernel(v), _AT, _ATl)
+    else:
+        hp = precision if precision is not None else jax.lax.Precision.HIGHEST
+        A = mat_refs[0][:]        # (m, n)
+        AT = mat_refs[1][:]       # (n, m)
+
+        def rmv(v, _A=A):
+            return jax.lax.dot_general(
+                v, _A, (((1,), (0,)), ((), ())),
+                precision=hp, preferred_element_type=jnp.float32)
+
+        def mv(v, _AT=AT):
+            return jax.lax.dot_general(
+                v, _AT, (((1,), (0,)), ((), ())),
+                precision=hp, preferred_element_type=jnp.float32)
 
     def body(_, carry):
         x, y, xs, ys = carry
-        aty = jax.lax.dot_general(
-            y, A, (((1,), (0,)), ((), ())),
-            precision=hp, preferred_element_type=jnp.float32)
-        v = x - tau * aty
-        x1 = jnp.clip((v - tau * c) / (1.0 + tau * q), l, u)
-        ax = jax.lax.dot_general(
-            2.0 * x1 - x, AT, (((1,), (0,)), ((), ())),
-            precision=hp, preferred_element_type=jnp.float32)
+        aty = rmv(y)            # A'y -> (T, n)
+        x1 = jnp.clip((x - tau * aty - tc) * pre, l, u)
+        ax = mv(2.0 * x1 - x)   # A(2x1 - x) -> (T, m)
         w = y + sigma * ax
-        y1 = w - sigma * jnp.clip(w / sigma, bl, bu)
-        x1 = x + live * (x1 - x)
-        y1 = y + live * (y1 - y)
-        # frozen scenarios keep accumulating their (frozen) iterate,
-        # matching the XLA path exactly (ops/pdhg.py _pdhg_iter)
+        y1 = w - jnp.clip(w, sbl, sbu)
         return x1, y1, xs + x1, ys + y1
 
     x, y, xs, ys = jax.lax.fori_loop(
@@ -114,10 +198,12 @@ def supported(p) -> bool:
         and getattr(A, "ndim", 0) == 2 and p.c.ndim == 2
 
 
-@partial(jax.jit, static_argnames=("n_iters", "tile_s", "interpret"))
+@partial(jax.jit,
+         static_argnames=("n_iters", "tile_s", "precision", "interpret"))
 def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
                tau: Array, sigma: Array, done: Array,
-               n_iters: int, tile_s: int = 128, interpret: bool = False):
+               n_iters: int, tile_s: int = 128,
+               precision: str | None = None, interpret: bool = False):
     """n_iters PDHG iterations over the whole scenario batch via the
     tiled Pallas kernel.  Returns (x, y, x_sum, y_sum).
 
@@ -135,9 +221,29 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
     S_p = _round_up(S, tile_s)
     dt = x.dtype
 
+    from mpisppy_tpu.ops import boxqp
+    # Resolve the module default HERE (trace time) so both engines honor
+    # set_matvec_precision identically; a default of HIGH routes to the
+    # manual three-pass decomposition (Mosaic rejects Precision.HIGH in
+    # dot_general, so passing it through would crash the kernel).
+    prec = boxqp.as_precision(precision)
+    if prec is None:
+        prec = boxqp.MATVEC_PRECISION
+    three_pass = prec == jax.lax.Precision.HIGH
+
     A = jnp.asarray(p.A, dt)
     A_pad = jnp.pad(A, ((0, m_p - m), (0, n_p - n)))
     AT_pad = A_pad.T
+    if three_pass:
+        # hi/lo bf16 split of the shared matrix, done once per call —
+        # the kernel runs 3 single-pass bf16 dots per matvec (see
+        # _dot3).  MUST go through _split_bf16 (reduce_precision):
+        # run_window is jitted XLA code, so an astype round trip here
+        # would be simplifier-folded and zero the lo matrix.
+        A_hi, A_lo = _split_bf16(A_pad)
+        mats = (A_hi, A_hi.T, A_lo, A_lo.T)
+    else:
+        mats = (A_pad, AT_pad)
 
     def prep(arr, last, fill, batched_fill=None):
         """Pad last dim; pad/keep the scenario dim (shared stays (1,.))."""
@@ -201,18 +307,20 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
 
     out_specs = [ospec(n_p), ospec(m_p), ospec(n_p), ospec(m_p)]
 
+    mat_specs = [aspec, atspec] * (len(mats) // 2)
     xo, yo, xso, yso = pl.pallas_call(
-        partial(_window_kernel, n_iters),
+        partial(_window_kernel, n_iters, prec),
         grid=grid,
         in_specs=[sspec, sspec, sspec,
                   vspec(c, n_p), vspec(q, n_p), vspec(l, n_p), vspec(u, n_p),
-                  vspec(bl, m_p), vspec(bu, m_p), aspec, atspec,
+                  vspec(bl, m_p), vspec(bu, m_p),
+                  *mat_specs,
                   vspec(x_p, n_p), vspec(y_p, m_p),
                   vspec(xs_p, n_p), vspec(ys_p, m_p)],
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(tau_p, sigma_p, done_p, c, q, l, u, bl, bu, A_pad, AT_pad,
+    )(tau_p, sigma_p, done_p, c, q, l, u, bl, bu, *mats,
       x_p, y_p, xs_p, ys_p)
 
     return (xo[:S, :n], yo[:S, :m], xso[:S, :n], yso[:S, :m])

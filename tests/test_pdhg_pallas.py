@@ -1,0 +1,120 @@
+# Interpret-mode coverage for the Pallas VMEM window kernel
+# (ops/pdhg_pallas.py) — the TPU engine behind PDHGOptions.use_pallas.
+# The real-chip path differs only in lowering; interpret mode runs the
+# same kernel trace on CPU, so the math (hoisted invariants, folded
+# done-masking, the manual bf16x3 three-pass matvec) is exercised in CI.
+# Role model: the reference tests its solver plumbing on tiny instances
+# without real solvers (ref:mpisppy/tests/test_ef_ph.py builds 3-scenario
+# farmer models); here the "solver" is ours, so we check it directly.
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.ops.boxqp import make_boxqp
+
+
+def _random_batch_lp(S=5, m=7, n=11, seed=0):
+    """Small feasible batched LP with a SHARED dense A (the Pallas
+    kernel's supported shape) and per-scenario c/rhs."""
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(m, n))
+    x_feas = rng.uniform(0.2, 0.8, size=(S, n))
+    slack = rng.uniform(0.5, 1.5, size=(S, m))
+    b = np.einsum("mn,sn->sm", A, x_feas)
+    return make_boxqp(
+        c=rng.normal(size=(S, n)),
+        A=A,
+        bl=b - slack,
+        bu=b + slack,
+        l=np.zeros((S, n)),
+        u=np.ones((S, n)),
+    )
+
+
+def _run(p, opts, n_windows=2):
+    st0 = pdhg.init_state(p, opts)
+    return pdhg.solve_fixed(p, n_windows, opts, st0)
+
+
+@pytest.mark.parametrize("iter_precision", [None, "high"])
+def test_window_kernel_matches_xla_path(iter_precision):
+    p = _random_batch_lp()
+    xla = _run(p, pdhg.PDHGOptions(use_pallas=False,
+                                   iter_precision=iter_precision))
+    pal = _run(p, pdhg.PDHGOptions(use_pallas=True,
+                                   iter_precision=iter_precision))
+    # same math up to float reassociation (None) or the bf16x3 manual
+    # decomposition standing in for Precision.HIGH ("high")
+    tol = 1e-4 if iter_precision is None else 5e-2
+    np.testing.assert_allclose(pal.x, xla.x, atol=tol, rtol=tol)
+    np.testing.assert_allclose(pal.y, xla.y, atol=tol, rtol=tol)
+    np.testing.assert_allclose(pal.x_sum, xla.x_sum, atol=80 * tol,
+                               rtol=tol)
+
+
+def test_done_scenarios_are_frozen():
+    """The folded done-masking (tau=sigma=0) must be an exact no-op on
+    frozen scenarios while window sums keep accumulating the frozen
+    iterate — the same contract as the XLA path's where-blend."""
+    p = _random_batch_lp(S=4)
+    opts = pdhg.PDHGOptions(use_pallas=True, restart_period=6)
+    st0 = pdhg.init_state(p, opts)
+    # mark scenarios 1 and 3 done with distinctive iterates
+    x_mark = jnp.clip(st0.x + 0.25, p.l, p.u)
+    done = jnp.array([False, True, False, True])
+    st0 = dataclasses.replace(st0, x=x_mark, done=done)
+
+    from mpisppy_tpu.ops import pdhg_pallas
+    tau = opts.step_margin * st0.omega / st0.Lnorm
+    sigma = opts.step_margin / (st0.omega * st0.Lnorm)
+    x, y, xs, ys = pdhg_pallas.run_window(
+        p, st0.x, st0.y, st0.x_sum, st0.y_sum, tau, sigma, st0.done,
+        opts.restart_period, interpret=True)
+    np.testing.assert_allclose(x[1], x_mark[1], atol=1e-6)
+    np.testing.assert_allclose(x[3], x_mark[3], atol=1e-6)
+    np.testing.assert_allclose(y[1], st0.y[1], atol=1e-6)
+    # frozen scenarios accumulate their frozen iterate every iteration
+    np.testing.assert_allclose(
+        xs[1], opts.restart_period * x_mark[1], atol=1e-5)
+    # live scenarios actually moved
+    assert float(jnp.max(jnp.abs(x[0] - x_mark[0]))) > 1e-6
+
+
+def test_padding_is_exact_noop():
+    """Scenario counts and row/col dims that don't divide the hardware
+    tiles must give the same answer as an aligned problem (pad scenarios
+    frozen, pad columns pinned at 0, pad rows dual-pinned at 0)."""
+    p = _random_batch_lp(S=3, m=5, n=9, seed=1)
+    xla = _run(p, pdhg.PDHGOptions(use_pallas=False))
+    pal = _run(p, pdhg.PDHGOptions(use_pallas=True, pallas_tile_s=8))
+    np.testing.assert_allclose(pal.x, xla.x, atol=1e-4, rtol=1e-4)
+
+
+def test_three_pass_dot_accuracy():
+    """The manual bf16x3 decomposition must be far more accurate than a
+    single bf16 pass (it mirrors Precision.HIGH, which Mosaic rejects)."""
+    rng = np.random.default_rng(3)
+    v32 = rng.normal(size=(16, 64)).astype(np.float32)
+    M32 = rng.normal(size=(64, 32)).astype(np.float32)
+    # f64 numpy reference: jnp matmul is NOT a trustworthy reference
+    # here (some backends run DEFAULT-precision f32 matmuls as bf16
+    # passes — measured on both the axon CPU backend and v5e)
+    exact = (v32.astype(np.float64) @ M32.astype(np.float64)).astype(
+        np.float32)
+    v, M = jnp.asarray(v32), jnp.asarray(M32)
+    from mpisppy_tpu.ops.pdhg_pallas import _dot3, _split_bf16
+    hi, lo = _split_bf16(M)
+    got = jax.jit(lambda v, hi, lo: _dot3(_split_bf16(v), hi, lo))(
+        v, hi, lo)
+    one_pass = jax.jit(lambda a, b: jax.lax.dot_general(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32))(v, M)
+    err3 = float(jnp.max(jnp.abs(got - exact)))
+    err1 = float(jnp.max(jnp.abs(one_pass - exact)))
+    assert err3 < err1 / 50
+    assert err3 < 5e-4
