@@ -60,6 +60,49 @@ class FusedWheelOptions:
     # hub's spoke_sync_period: bound freshness lags at most
     # spoke_period iterations, per-iteration cost amortizes by 1/p
     spoke_period: int = 1
+    # Dispatch each plane as its OWN async device program instead of
+    # one monolithic jit.  Measured on v5e at S=10k: the monolithic
+    # 4-plane program costs +428 ms/iter over bare PH while the same
+    # planes as separate dispatches cost +198 ms — XLA interleaves the
+    # data-independent window loops and they evict each other's
+    # VMEM-resident state, and async dispatch already hides the ~6 ms
+    # tunnel latency.  Split mode is also what makes per-plane adaptive
+    # budgets cheap (one small recompile per plane/budget pair).
+    split_dispatch: bool = True
+    # Adaptive budgets (split mode only): a plane runs its full budget
+    # until it has CERTIFIED (dual-residual / feasibility gate) for
+    # `adapt_stall` consecutive exchanges — its warm solver is then
+    # tracking its slowly moving target and the lean budget keeps it
+    # certified; any uncertified exchange snaps it back to full.  The
+    # certificates are identical either way — budgets only change how
+    # fast the warm solver tracks, never what gets certified.
+    adapt_budgets: bool = True
+    lean_lag_windows: int = 2
+    lean_xhat_windows: int = 1
+    lean_slam_windows: int = 1
+    lean_shuffle_windows: int = 1
+    adapt_stall: int = 3
+    # Candidate FREEZING for the x̄ plane (split mode): the evaluated
+    # candidate stays frozen across exchanges until it lands (publishes
+    # feasible) or xhat_give_up exchanges pass, and only then does the
+    # plane adopt a fresh round(x̄).  Without this the candidate churns
+    # every exchange and the straggler scenarios' recourse solves never
+    # accumulate enough iterations to clear the all-scenario feasibility
+    # gate — measured on sslp-10k: 0/90 exchanges published and the
+    # 80-second blocking rescue did all the inner-bound work.
+    xhat_give_up: int = 25
+    # In-loop STRAGGLER TAIL sub-solve: after the main fixed-budget
+    # pass, gather the xhat_tail_k worst-primal-residual scenarios into
+    # a tiny sub-batch and run them xhat_tail_windows windows at the
+    # tier-2 rescue profile (omega0=0.03, restart_period=160), then
+    # scatter the state back.  ~0.1-0.3% of sslp recourse LPs are
+    # degenerate and need O(100k) PDHG iterations (measured r3/r5) —
+    # on the full 10k batch that was only reachable by an 80-second
+    # blocking rescue, but on a 64-scenario gather it costs ~1% of a
+    # hub step per exchange and accumulates across exchanges on the
+    # frozen candidate.  0 disables.
+    xhat_tail_k: int = 64
+    xhat_tail_windows: int = 12
     lag_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
         tol=1e-6, restart_period=40)
     xhat_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
@@ -71,6 +114,7 @@ class FusedWheelOptions:
     jax.tree_util.register_dataclass,
     data_fields=["ph", "lag_solver", "lag_bound", "lag_certified",
                  "xhat_solver", "xhat_cand", "xhat_value", "xhat_feasible",
+                 "xhat_dead",
                  "slam_solver", "slam_cand", "slam_value", "slam_feasible",
                  "shuf_solver", "shuf_cand", "shuf_value", "shuf_feasible",
                  "scalars"],
@@ -86,6 +130,8 @@ class FusedWheelState:
     xhat_cand: Array             # (num_nodes, N) candidate evaluated
     xhat_value: Array            # () E[f(xhat)]; +inf unless feasible
     xhat_feasible: Array         # () bool
+    xhat_dead: Array             # () bool: some scenario CERTIFIED
+    #                              infeasible/unbounded at this candidate
     slam_solver: pdhg.PDHGState  # warm iterates for the slam candidate
     slam_cand: Array             # (N,) slammed candidate
     slam_value: Array            # ()
@@ -94,8 +140,7 @@ class FusedWheelState:
     shuf_cand: Array             # (N,) candidate (one scenario's nonants)
     shuf_value: Array            # ()
     shuf_feasible: Array         # () bool
-    # (9,) f32 [conv, lag_bound, lag_cert, xhat_value, xhat_feas,
-    # slam_value, slam_feas, shuf_value, shuf_feas]: every per-iteration
+    # (10,) f32 — see SCALAR_KEYS for the layout: every per-iteration
     # host decision packed into ONE device array so the hub pays ONE
     # device->host transfer per iteration (the axon tunnel charges a
     # full round trip per scalar read — ~10 reads/iter measurably
@@ -104,11 +149,12 @@ class FusedWheelState:
 
 
 def _lag_step(batch: ScenarioBatch, W: Array, solver: pdhg.PDHGState,
-              wopts: FusedWheelOptions):
+              wopts: FusedWheelOptions, windows: int | None = None):
     """Advance the Lagrangian solve a fixed budget and certify the bound
     (same math as algos.lagrangian.lagrangian_bound, truncated)."""
     qp = lag_mod._lagrangian_qp(batch, W)
-    st = pdhg.solve_fixed(qp, wopts.lag_windows, wopts.lag_pdhg, solver)
+    n_win = wopts.lag_windows if windows is None else windows
+    st = pdhg.solve_fixed(qp, n_win, wopts.lag_pdhg, solver)
     dual = boxqp.dual_objective(qp, st.x, st.y)
     _, rd, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     tol = jnp.maximum(wopts.lag_pdhg.tol,
@@ -118,27 +164,110 @@ def _lag_step(batch: ScenarioBatch, W: Array, solver: pdhg.PDHGState,
     return st, batch.expectation(dual), certified
 
 
+def _gather_scen(tree, idx, S: int):
+    """Index the leading scenario axis of every (S, ...)-shaped leaf.
+    Safe for PDHGState (every array field is (S, ...) or a () scalar by
+    construction); do NOT use on a BoxQP — see _gather_qp."""
+    return jax.tree_util.tree_map(
+        lambda a: a[idx] if (getattr(a, "ndim", 0) > 0
+                             and a.shape[0] == S) else a, tree)
+
+
+def _gather_qp(qp, idx, S: int):
+    """Scenario-gather a BoxQP by FIELD LAYOUT, not dim-size guessing:
+    a shared dense A is (m, n), and a model with m == S would trip a
+    naive shape[0]-equals-S test into gathering the matrix by scenario
+    index (wrong contraction downstream)."""
+    def vec(a):       # c/q/l/u: (S, n) batched or (n,) shared
+        return a[idx] if a.ndim == 2 else a
+
+    A = qp.A
+    if hasattr(A, "matvec"):      # EllMatrix: leaves are (S, ...) or shared
+        A = _gather_scen(A, idx, S)
+    elif A.ndim == 3:             # per-scenario dense (S, m, n)
+        A = A[idx]
+    # else shared dense (m, n): keep
+    return dataclasses.replace(
+        qp, c=vec(qp.c), q=vec(qp.q), l=vec(qp.l), u=vec(qp.u),
+        bl=vec(qp.bl), bu=vec(qp.bu), A=A)
+
+
+def _scatter_scen(tree, sub, idx, S: int):
+    """Write a gathered sub-tree back into the (S, ...) leaves."""
+    return jax.tree_util.tree_map(
+        lambda a, b: (a.at[idx].set(b)
+                      if (getattr(a, "ndim", 0) > 0 and a.shape[0] == S)
+                      else a), tree, sub)
+
+
+def _tail_rescue(qp, st: pdhg.PDHGState, rp: Array, real: Array,
+                 wopts: FusedWheelOptions) -> pdhg.PDHGState:
+    """In-loop straggler sub-solve (see FusedWheelOptions.xhat_tail_k):
+    top-k worst residual scenarios get a large extra budget at the
+    tier-2 rescue profile on a gathered sub-batch, state scattered
+    back.  Runs inside the same jitted plane program."""
+    S = st.omega.shape[0]
+    k = min(wopts.xhat_tail_k, S)
+    if k <= 0 or wopts.xhat_tail_windows <= 0:
+        return st
+    _, idx = jax.lax.top_k(jnp.where(real, rp, -1.0), k)
+    sub_qp = _gather_qp(qp, idx, S)
+    sub_st = _gather_scen(st, idx, S)
+    topts = dataclasses.replace(
+        wopts.xhat_pdhg, omega0=0.03, restart_period=160)
+    sub_st = dataclasses.replace(
+        sub_st, omega=jnp.full_like(sub_st.omega, topts.omega0))
+    sub_st = pdhg.solve_fixed(sub_qp, wopts.xhat_tail_windows, topts,
+                              sub_st)
+    return _scatter_scen(st, sub_st, idx, S)
+
+
 def _eval_step(batch: ScenarioBatch, cand: Array,
                solver: pdhg.PDHGState, windows: int,
-               wopts: FusedWheelOptions):
+               wopts: FusedWheelOptions, tail: bool = False):
     """Advance the recourse evaluation of a fixed candidate a fixed
     budget.  The candidate moves every iteration, but consecutive
     candidates differ little, so the warm iterates (clipped into the new
     fixed box) track it — the fused analog of XhatXbarInnerBound's warm
     PDHG state.  Validity: the value only counts when EVERY real
     scenario's primal residual clears feas_tol, so a truncated or
-    genuinely infeasible solve can never produce an incumbent."""
+    genuinely infeasible solve can never produce an incumbent.
+
+    The published value is COMPENSATED for residual infeasibility: an
+    rp-infeasible x can undershoot the true recourse optimum by up to
+    ~|y*|'viol (first order), so E[sum_i |y_i| viol_i] is added before
+    publication.  The reference never needs this (Gurobi returns exactly
+    feasible candidates, ref:mpisppy/spopt.py:884); a truncated
+    first-order solve does, or lean warm budgets can publish inner
+    bounds below the optimum (observed on farmer: 8e-4 relative leak).
+    Exactly feasible solves pay zero."""
     qp = batch.with_fixed_nonants(cand)
     st = dataclasses.replace(solver, x=jnp.clip(solver.x, qp.l, qp.u))
-    st = pdhg.solve_fixed(qp, windows, wopts.xhat_pdhg, st)
-    obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
-    rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    # detect_infeas: a candidate that leaves ANY scenario without
+    # feasible recourse gets a Farkas certificate within a few windows;
+    # the host reads the `dead` flag and adopts a fresh candidate next
+    # exchange instead of burning xhat_give_up exchanges (or an
+    # 80-second blocking rescue, both observed on sslp-10k) on it.
+    popts = dataclasses.replace(wopts.xhat_pdhg, detect_infeas=True)
+    st = pdhg.solve_fixed(qp, windows, popts, st)
     real = batch.p > 0.0
-    ok = rp <= wopts.xhat_feas_tol
+    if tail:
+        # straggler sub-solve: x-hat plane only — the slam/shuffle
+        # planes rotate candidates and must stay cheap
+        rp0, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+        st = _tail_rescue(qp, st, rp0, real, wopts)
+    obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
+    viol = boxqp.primal_residual(qp, st.x)
+    obj = obj + jnp.sum(jnp.abs(st.y) * viol, axis=-1)
+    rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    bad_status = (st.status == pdhg.INFEASIBLE) \
+        | (st.status == pdhg.UNBOUNDED)
+    ok = (rp <= wopts.xhat_feas_tol) & ~bad_status
     feas = jnp.all(jnp.where(real, ok, True))
+    dead = jnp.any(jnp.where(real, bad_status, False))
     value = jnp.where(feas, batch.expectation(obj),
                       jnp.asarray(jnp.inf, obj.dtype))
-    return st, value, feas
+    return st, value, feas, dead
 
 
 @partial(jax.jit, static_argnames=("opts", "wopts"))
@@ -161,6 +290,7 @@ def fused_iter0(batch: ScenarioBatch, rho: Array, opts: ph_mod.PHOptions,
         xhat_cand=jnp.zeros((batch.tree.num_nodes, batch.num_nonants), dt),
         xhat_value=jnp.asarray(jnp.inf, dt),
         xhat_feasible=jnp.asarray(False),
+        xhat_dead=jnp.asarray(False),
         slam_solver=xhat_solver,
         slam_cand=jnp.zeros((batch.num_nonants,), dt),
         slam_value=jnp.asarray(jnp.inf, dt),
@@ -169,7 +299,7 @@ def fused_iter0(batch: ScenarioBatch, rho: Array, opts: ph_mod.PHOptions,
         shuf_cand=jnp.zeros((batch.num_nonants,), dt),
         shuf_value=jnp.asarray(jnp.inf, dt),
         shuf_feasible=jnp.asarray(False),
-        scalars=jnp.zeros((9,), dt),
+        scalars=jnp.zeros((10,), dt),
     )
     return dataclasses.replace(st, scalars=_pack_scalars(st)), tb, cert
 
@@ -182,6 +312,7 @@ def _pack_scalars(st: "FusedWheelState") -> Array:
         st.lag_certified.astype(dt),
         st.xhat_value.astype(dt),
         st.xhat_feasible.astype(dt),
+        st.xhat_dead.astype(dt),
         st.slam_value.astype(dt),
         st.slam_feasible.astype(dt),
         st.shuf_value.astype(dt),
@@ -190,8 +321,8 @@ def _pack_scalars(st: "FusedWheelState") -> Array:
 
 
 SCALAR_KEYS = ("conv", "lag_bound", "lag_certified", "xhat_value",
-               "xhat_feasible", "slam_value", "slam_feasible",
-               "shuf_value", "shuf_feasible")
+               "xhat_feasible", "xhat_dead", "slam_value",
+               "slam_feasible", "shuf_value", "shuf_feasible")
 
 
 @partial(jax.jit, static_argnames=("opts", "wopts"))
@@ -204,26 +335,49 @@ def fused_iterk(batch: ScenarioBatch, st: FusedWheelState,
     fixed warm budget."""
     phst = ph_mod.ph_iterk(batch, st.ph, opts)
     out = dataclasses.replace(st, ph=phst)
+
+    # The planes are data-independent given phst, so XLA freely
+    # interleaves their window loops — measured on v5e at S=10k this is
+    # strongly superadditive (individual plane extras sum to 240 ms but
+    # the 4-plane program costs +428 ms: interleaved loops evict each
+    # other's VMEM-resident solver state).  `fence` threads each
+    # plane's warm inputs through an optimization_barrier with the
+    # previous plane's outputs, forcing the planes to run one after
+    # another, each with the VMEM to itself.
+    done_vals = [phst]
+
+    def fence(*vals):
+        fenced = jax.lax.optimization_barrier(tuple(done_vals) + vals)
+        return fenced[len(done_vals):]
+
     if wopts.lag_windows > 0:
+        (lag_in,) = fence(st.lag_solver)
         lag_solver, lag_bound, lag_cert = _lag_step(
-            batch, phst.W, st.lag_solver, wopts)
+            batch, phst.W, lag_in, wopts)
         out = dataclasses.replace(out, lag_solver=lag_solver,
                                   lag_bound=lag_bound,
                                   lag_certified=lag_cert)
+        done_vals.append(lag_solver)
     if wopts.xhat_windows > 0:
         cand = xhat_mod.round_integers(batch, phst.xbar_nodes)
-        xs, value, feas = _eval_step(batch, cand, st.xhat_solver,
-                                     wopts.xhat_windows, wopts)
+        (xhat_in,) = fence(st.xhat_solver)
+        xs, value, feas, dead = _eval_step(batch, cand, xhat_in,
+                                           wopts.xhat_windows, wopts,
+                                           tail=True)
         out = dataclasses.replace(out, xhat_solver=xs, xhat_cand=cand,
-                                  xhat_value=value, xhat_feasible=feas)
+                                  xhat_value=value, xhat_feasible=feas,
+                                  xhat_dead=dead)
+        done_vals.append(xs)
     if wopts.slam_windows > 0 or wopts.shuffle_windows > 0:
         x_non = batch.nonants(phst.solver.x)
     if wopts.slam_windows > 0:
         scand = xhat_mod.slam_candidate(batch, x_non, wopts.slam_sense_max)
-        ss, svalue, sfeas = _eval_step(batch, scand, st.slam_solver,
-                                      wopts.slam_windows, wopts)
+        (slam_in,) = fence(st.slam_solver)
+        ss, svalue, sfeas, _ = _eval_step(batch, scand, slam_in,
+                                          wopts.slam_windows, wopts)
         out = dataclasses.replace(out, slam_solver=ss, slam_cand=scand,
                                   slam_value=svalue, slam_feasible=sfeas)
+        done_vals.append(ss)
     if wopts.shuffle_windows > 0:
         # one rotating candidate per iteration (the host supplies the
         # deterministic shuffle index, seed 42 — ref:
@@ -231,11 +385,85 @@ def fused_iterk(batch: ScenarioBatch, st: FusedWheelState,
         # scenarios' own first stages like the reference's looper
         sid = jnp.asarray(0, jnp.int32) if shuf_id is None else shuf_id
         fcand = xhat_mod.round_integers(batch, x_non[sid])
-        fs, fvalue, ffeas = _eval_step(batch, fcand, st.shuf_solver,
-                                       wopts.shuffle_windows, wopts)
+        (shuf_in,) = fence(st.shuf_solver)
+        fs, fvalue, ffeas, _ = _eval_step(batch, fcand, shuf_in,
+                                          wopts.shuffle_windows, wopts)
         out = dataclasses.replace(out, shuf_solver=fs, shuf_cand=fcand,
                                   shuf_value=fvalue, shuf_feasible=ffeas)
     return dataclasses.replace(out, scalars=_pack_scalars(out))
+
+
+# --- split-dispatch plane programs -----------------------------------
+# Each plane as its own small jitted program (see
+# FusedWheelOptions.split_dispatch).  `windows` is static: the adaptive
+# controller only ever uses the {full, lean} pair per plane, so at most
+# two compiles per plane exist per run.
+
+@partial(jax.jit, static_argnames=("wopts", "windows"))
+def lag_plane(batch, W, solver, wopts, windows):
+    return _lag_step(batch, W, solver, wopts, windows)
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def _round_xbar(batch, xbar_nodes, mode="nearest"):
+    return xhat_mod.round_integers(batch, xbar_nodes, mode)
+
+
+@partial(jax.jit, static_argnames=("wopts", "windows"))
+def xhat_plane(batch, cand, solver, wopts, windows):
+    st, value, feas, dead = _eval_step(batch, cand, solver, windows, wopts,
+                                       tail=True)
+    return st, value, feas, dead
+
+
+@partial(jax.jit, static_argnames=("wopts", "windows", "sense_max"))
+def slam_plane(batch, x, solver, wopts, windows, sense_max):
+    x_non = batch.nonants(x)
+    scand = xhat_mod.slam_candidate(batch, x_non, sense_max)
+    st, value, feas, _ = _eval_step(batch, scand, solver, windows, wopts)
+    return st, scand, value, feas
+
+
+@partial(jax.jit, static_argnames=("wopts", "windows"))
+def shuf_plane(batch, x, solver, sid, wopts, windows):
+    x_non = batch.nonants(x)
+    fcand = xhat_mod.round_integers(batch, x_non[sid])
+    st, value, feas, _ = _eval_step(batch, fcand, solver, windows, wopts)
+    return st, fcand, value, feas
+
+
+@jax.jit
+def _pack_scalars_jit(st: "FusedWheelState") -> Array:
+    return _pack_scalars(st)
+
+
+class _PlaneBudget:
+    """Host-side controller driving one plane's {full, lean} budget off
+    its CERTIFICATION streak.
+
+    Rationale: once a plane's warm solver certifies (dual residual for
+    the Lagrangian, primal feasibility for the candidate evaluations)
+    for `stall_after` consecutive exchanges, it is tracking its slowly
+    moving target and a lean budget keeps it certified; the moment
+    certification is lost the budget snaps back to full.  Validity is
+    unaffected either way — certificates gate every published value
+    identically at any budget; lean can only cost bound freshness,
+    and an under-budgeted plane immediately reveals itself by failing
+    to certify (which restores the full budget)."""
+
+    def __init__(self, full: int, lean: int, stall_after: int):
+        self.full = full
+        self.lean = max(1, min(lean, full)) if full > 0 else 0
+        self.stall_after = stall_after
+        self.streak = 0
+
+    def windows(self) -> int:
+        if self.full <= 0:
+            return 0
+        return self.lean if self.streak >= self.stall_after else self.full
+
+    def observe(self, certified: bool) -> None:
+        self.streak = self.streak + 1 if certified else 0
 
 
 class FusedPH(ph_mod.PH):
@@ -256,6 +484,20 @@ class FusedPH(ph_mod.PH):
         self._shuf_order = np.random.default_rng(42).permutation(
             batch.num_real)
         self._shuf_cursor = 0
+        self._xhat_frozen_for = 0
+        self._xhat_has_cand = False
+        self._xhat_round_mode = "nearest"
+        w = self.wheel_options
+        stall = w.adapt_stall if w.adapt_budgets else (1 << 30)
+        self._budgets = {
+            "lag": _PlaneBudget(w.lag_windows, w.lean_lag_windows, stall),
+            "xhat": _PlaneBudget(w.xhat_windows, w.lean_xhat_windows,
+                                 stall),
+            "slam": _PlaneBudget(w.slam_windows, w.lean_slam_windows,
+                                 stall),
+            "shuf": _PlaneBudget(w.shuffle_windows,
+                                 w.lean_shuffle_windows, stall),
+        }
 
     def _cache_scalars(self, pipelined: bool = False):
         """ONE device->host transfer per iteration: everything the hub
@@ -308,18 +550,109 @@ class FusedPH(ph_mod.PH):
         self._shuf_cursor = (self._shuf_cursor + 1) % len(self._shuf_order)
         wopts = self.wheel_options
         p = max(1, int(wopts.spoke_period))
-        if p > 1 and (self._iter % p) != 0:
-            # hub-only variant: spoke planes skipped, their state/bounds
-            # carried untouched (harvests re-read last values — folding
-            # is idempotent)
-            wopts = dataclasses.replace(
-                wopts, lag_windows=0, xhat_windows=0, slam_windows=0,
-                shuffle_windows=0)
-        # self.state may have been rebound by extensions/convergers
-        # (e.g. rho updaters) — fold it back into the wheel state first
-        self.wstate = fused_iterk(
-            self.batch,
-            dataclasses.replace(self.wstate, ph=self.state),
-            ph_mod.kernel_opts(self.options), wopts, sid)
+        spoke_iter = p <= 1 or (self._iter % p) == 0
+        if wopts.split_dispatch:
+            self.wstate = self._iterk_split(wopts, sid, spoke_iter)
+        else:
+            w = wopts
+            if not spoke_iter:
+                # hub-only variant: spoke planes skipped, their
+                # state/bounds carried untouched (harvests re-read last
+                # values — folding is idempotent)
+                w = dataclasses.replace(
+                    w, lag_windows=0, xhat_windows=0, slam_windows=0,
+                    shuffle_windows=0)
+            # self.state may have been rebound by extensions/convergers
+            # (e.g. rho updaters) — fold it back into the wheel state
+            self.wstate = fused_iterk(
+                self.batch,
+                dataclasses.replace(self.wstate, ph=self.state),
+                ph_mod.kernel_opts(self.options), w, sid)
         self._cache_scalars(pipelined=True)
+        if spoke_iter:
+            self._observe_progress()
         return self.wstate.ph
+
+    def _iterk_split(self, wopts: FusedWheelOptions, sid,
+                     spoke_iter: bool) -> FusedWheelState:
+        """One wheel iteration as a PIPELINE of async dispatches: the
+        hub PH step, then each enabled plane as its own program, then
+        the scalar pack.  Nothing here blocks the host — the device
+        queue drains them back-to-back, and the ~6 ms-per-dispatch
+        tunnel latency hides behind execution (measured: the monolithic
+        fused program is 1.8x slower at S=10k; see split_dispatch)."""
+        batch = self.batch
+        phst = ph_mod.ph_iterk(batch, self.state,
+                               ph_mod.kernel_opts(self.options))
+        out = dataclasses.replace(self.wstate, ph=phst)
+        if spoke_iter:
+            b = self._budgets
+            if b["lag"].windows() > 0:
+                ls, lb, lc = lag_plane(batch, phst.W, out.lag_solver,
+                                       wopts, b["lag"].windows())
+                out = dataclasses.replace(
+                    out, lag_solver=ls, lag_bound=lb, lag_certified=lc)
+            if b["xhat"].windows() > 0:
+                sc = self.scalar_cache or {}
+                # the pipelined scalar cache lags TWO iterations (see
+                # _cache_scalars), so right after an adoption the
+                # landed/dead flags still describe the PREVIOUS
+                # candidate — acting on them would rotate twice and
+                # skip a rounding tier; trust them only once this
+                # candidate has been evaluated pipeline-depth exchanges
+                flags_fresh = self._xhat_frozen_for >= 2
+                landed = flags_fresh and bool(sc.get("xhat_feasible", 0.0))
+                dead = flags_fresh and bool(sc.get("xhat_dead", 0.0))
+                give_up = self._xhat_frozen_for >= wopts.xhat_give_up
+                if landed or dead or give_up or not self._xhat_has_cand:
+                    if landed:
+                        # a landed candidate validates the current
+                        # rounding direction — keep it
+                        pass
+                    elif dead or give_up:
+                        # escalate the rounding direction: on sslp-like
+                        # models nearest-rounding strands recourse
+                        # demand and the candidate is CERTIFIED dead;
+                        # ceil opens every fractional facility
+                        order = ("nearest", "ceil", "floor")
+                        i = order.index(self._xhat_round_mode)
+                        self._xhat_round_mode = order[(i + 1) % 3]
+                    cand = _round_xbar(batch, phst.xbar_nodes,
+                                       self._xhat_round_mode)
+                    self._xhat_frozen_for = 0
+                    self._xhat_has_cand = True
+                else:
+                    cand = out.xhat_cand  # frozen: keep accumulating
+                    self._xhat_frozen_for += 1
+                xs, xv, xf, xd = xhat_plane(batch, cand, out.xhat_solver,
+                                            wopts, b["xhat"].windows())
+                out = dataclasses.replace(
+                    out, xhat_solver=xs, xhat_cand=cand, xhat_value=xv,
+                    xhat_feasible=xf, xhat_dead=xd)
+            if b["slam"].windows() > 0:
+                ss, scand, sv, sf = slam_plane(
+                    batch, phst.solver.x, out.slam_solver, wopts,
+                    b["slam"].windows(), wopts.slam_sense_max)
+                out = dataclasses.replace(
+                    out, slam_solver=ss, slam_cand=scand, slam_value=sv,
+                    slam_feasible=sf)
+            if b["shuf"].windows() > 0:
+                fs, fcand, fv, ff = shuf_plane(
+                    batch, phst.solver.x, out.shuf_solver, sid, wopts,
+                    b["shuf"].windows())
+                out = dataclasses.replace(
+                    out, shuf_solver=fs, shuf_cand=fcand, shuf_value=fv,
+                    shuf_feasible=ff)
+        return dataclasses.replace(out, scalars=_pack_scalars_jit(out))
+
+    def _observe_progress(self):
+        """Feed the (possibly one-iteration-stale, see _cache_scalars)
+        certification flags to the budget controllers.  Staleness only
+        delays a budget switch by one exchange — harmless."""
+        sc = self.scalar_cache
+        if not sc:
+            return
+        self._budgets["lag"].observe(bool(sc["lag_certified"]))
+        self._budgets["xhat"].observe(bool(sc["xhat_feasible"]))
+        self._budgets["slam"].observe(bool(sc["slam_feasible"]))
+        self._budgets["shuf"].observe(bool(sc["shuf_feasible"]))

@@ -188,10 +188,31 @@ def _evaluate_core(batch: ScenarioBatch, xhat: Array,
                       primal_resid=rp, status=st.status)
 
 
-def round_integers(batch: ScenarioBatch, xhat: Array) -> Array:
+def round_integers(batch: ScenarioBatch, xhat: Array,
+                   mode: str = "nearest") -> Array:
     """Round integer nonant slots (ref:mpisppy/extensions/xhatxbar.py's
-    rounding of xbar for integer variables)."""
-    return jnp.where(batch.integer_slot, jnp.round(xhat), xhat)
+    rounding of xbar for integer variables).
+
+    `mode` selects the rounding direction — "nearest" (the reference's
+    behavior), "ceil", or "floor".  The directional modes exist for the
+    candidate-tiering escalation in the fused x̄ plane: on models where
+    nearest-rounding yields recourse-infeasible candidates (e.g. sslp —
+    rounding a fractional server-open variable down can strand client
+    demand), ceil opens every fractionally-open facility and lands a
+    feasible, if conservative, incumbent.  Validity is unaffected:
+    every candidate still passes the recourse evaluator's feasibility
+    gate before its value counts."""
+    if mode == "nearest":
+        rounded = jnp.round(xhat)
+    elif mode == "ceil":
+        # 1e-2 dust guard: PH x̄ carries float noise, and a bare ceil
+        # would "open" every slot sitting at +1e-7
+        rounded = jnp.ceil(xhat - 1e-2)
+    elif mode == "floor":
+        rounded = jnp.floor(xhat + 1e-2)
+    else:  # pragma: no cover - guarded by static call sites
+        raise ValueError(f"unknown rounding mode: {mode}")
+    return jnp.where(batch.integer_slot, rounded, xhat)
 
 
 def xhat_xbar(batch: ScenarioBatch, xbar_nodes: Array,

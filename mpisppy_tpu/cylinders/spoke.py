@@ -188,8 +188,25 @@ class FusedXhatXbarInnerBound(InnerBoundSpoke):
         self._dry_harvests += 1
         if self._dry_harvests >= self.rescue_after:
             self._dry_harvests = 0
+            if sc.get("xhat_dead", 0.0) > 0.5:
+                # the candidate is CERTIFIED recourse-infeasible — a
+                # blocking to-convergence rescue would spend ~a minute
+                # re-proving it (observed); the plane is already
+                # rotating to a new candidate
+                return self.bound
             cand = jnp.asarray(self.opt.cand_cache["xhat"])
-            res = xhat_mod.evaluate(self.batch, cand, self.pdhg_opts)
+            # warm rescue: start from the in-loop plane's solver state
+            # (it has been tracking this candidate for many exchanges)
+            # instead of a cold to-convergence solve, and fold the
+            # polished state back so the plane keeps the benefit
+            wstate = getattr(self.opt, "wstate", None)
+            if wstate is not None:
+                res, st = xhat_mod.evaluate_warm(
+                    self.batch, cand, wstate.xhat_solver, self.pdhg_opts)
+                import dataclasses as _dc
+                self.opt.wstate = _dc.replace(wstate, xhat_solver=st)
+            else:
+                res = xhat_mod.evaluate(self.batch, cand, self.pdhg_opts)
             if bool(res.feasible):
                 self._offer(float(res.value), np.asarray(cand))
         return self.bound

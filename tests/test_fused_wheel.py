@@ -101,6 +101,77 @@ def test_fused_wheel_sslp_matches_classic_bracket():
     assert outer <= inner
 
 
+def test_split_dispatch_matches_monolithic():
+    """The split-dispatch pipeline (default) and the monolithic fused
+    program run the same plane math — the Lagrangian trajectory is
+    identical (tight tolerance), while the inner bound may differ
+    slightly because split mode freezes the evaluated candidate across
+    exchanges (see FusedWheelOptions.xhat_give_up).  Both must produce
+    a consistent certified bracket."""
+    batch = sslp_batch(16)
+    results = {}
+    for split in (True, False):
+        wopts = fw.FusedWheelOptions(split_dispatch=split,
+                                     adapt_budgets=False,
+                                     slam_windows=2, shuffle_windows=2)
+        ws = WheelSpinner(
+            fused_hub_dict(batch, rel_gap=1e-2, max_iterations=60,
+                           rho=20.0, wheel_options=wopts),
+            ALL_FUSED_SPOKES).spin()
+        results[split] = (ws.BestOuterBound, ws.BestInnerBound)
+    (o1, i1), (o2, i2) = results[True], results[False]
+    assert np.isfinite(o1) and np.isfinite(i1)
+    assert abs(o1 - o2) <= 1e-3 * max(1.0, abs(o2))
+    assert abs(i1 - i2) <= 5e-3 * max(1.0, abs(i2))
+    for outer, inner in results.values():
+        assert outer <= inner + 1e-6 * max(1.0, abs(inner))
+
+
+def test_plane_budget_controller():
+    b = fw._PlaneBudget(full=8, lean=2, stall_after=3)
+    assert b.windows() == 8
+    b.observe(True)
+    b.observe(True)
+    assert b.windows() == 8   # streak below threshold
+    b.observe(True)
+    assert b.windows() == 2   # lean after stall_after certified exchanges
+    b.observe(True)
+    assert b.windows() == 2   # stays lean while certified
+    b.observe(False)          # certification lost -> full immediately
+    assert b.windows() == 8
+    # uncertified exchanges keep full budget (still chasing the gate)
+    b2 = fw._PlaneBudget(full=4, lean=1, stall_after=2)
+    b2.observe(False)
+    b2.observe(False)
+    assert b2.windows() == 4
+    # disabled plane stays disabled
+    b3 = fw._PlaneBudget(full=0, lean=1, stall_after=2)
+    assert b3.windows() == 0
+
+
+def test_adaptive_budgets_engage_on_stalled_wheel():
+    """Once the planes certify streak-long, the controllers must drop
+    every enabled plane to its lean budget."""
+    batch = farmer_batch(3)
+    wopts = fw.FusedWheelOptions(
+        adapt_stall=2, slam_windows=2, shuffle_windows=2,
+        slam_sense_max=False,
+        lag_pdhg=pdhg.PDHGOptions(tol=1e-7),
+        xhat_pdhg=pdhg.PDHGOptions(tol=1e-7, omega0=0.1,
+                                   restart_period=80))
+    # an unreachable gap target forces the wheel to run out its
+    # iterations well past bound convergence
+    ws = WheelSpinner(fused_hub_dict(batch, rel_gap=-1.0,
+                                     max_iterations=40,
+                                     wheel_options=wopts),
+                      ALL_FUSED_SPOKES).spin()
+    budgets = ws.opt._budgets
+    assert budgets["lag"].windows() == wopts.lean_lag_windows
+    assert budgets["xhat"].windows() == wopts.lean_xhat_windows
+    # bounds are still a certified bracket after running lean
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+
+
 def test_fused_wheel_checkpoint_resume(tmp_path):
     batch = sslp_batch(16)
     ckpt = str(tmp_path / "wheel.ckpt.npz")
