@@ -496,18 +496,28 @@ def bench_hydro():
         pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40))
     # the fused Lagrangian plateaus ~3.5% below the LP optimum on hydro
     # (PH's dual converges slowly on this tree); the EF-bound spoke's
-    # warm dual solve provides the certified outer that closes the gap
+    # warm dual solve provides the certified outer that closes the gap.
+    # Inner: EFXhatInnerBound (root-fixed EF) — fixing ALL stages'
+    # nonants is structurally infeasible on hydro (stage-2 reservoir
+    # balance couples fixed nonants with stochastic inflow; duals ~1e6),
+    # so the fused x-bar recourse plane is disabled (round 4's 184.25
+    # "inner" at such points was an uncompensated-infeasibility artifact
+    # sitting BELOW the EF optimum ~186.2 — not a valid bound).
+    from mpisppy_tpu.algos.ef import build_ef
+    efp = build_ef(specs, tree=tree)
     spokes = [
         {"spoke_class": spoke_mod.EFOuterBound,
-         "opt_kwargs": {"options": {"specs": specs, "tree": tree,
+         "opt_kwargs": {"options": {"ef_problem": efp,
                                     "n_windows": 20}}},
         {"spoke_class": spoke_mod.FusedLagrangianOuterBound,
          "opt_kwargs": {"options": {}}},
-        {"spoke_class": spoke_mod.FusedXhatXbarInnerBound,
-         "opt_kwargs": {"options": {}}},
+        {"spoke_class": spoke_mod.EFXhatInnerBound,
+         "opt_kwargs": {"options": {"ef_problem": efp,
+                                    "n_windows": 20}}},
     ]
     return bench_wheel_to_gap(
         batch, f"hydro_3stage_{num}scen", spokes, ph_opts,
+        wheel_opts=fw.FusedWheelOptions(xhat_windows=0),
         extra_hub_opts={"spoke_sync_period": 5})
 
 

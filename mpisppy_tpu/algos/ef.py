@@ -141,6 +141,21 @@ def build_ef(specs: list[ScenarioSpec],
                      nonant_idx=nonant_idx, tree=tree)
 
 
+def root_fix_columns(efp: EFProblem):
+    """(flat_cols, d_flat): the EF-wide flat column indices of every
+    scenario block's ROOT-stage nonant slots, and their column scaling.
+    The single source of truth for 'fix the root nonants at x̂' —
+    shared by ExtensiveForm.fix_root_nonants and the EFXhatInnerBound
+    spoke so the column/scaling convention cannot drift."""
+    root_slots = np.nonzero(efp.tree.slot_stage == 1)[0]
+    cols_one = np.asarray(efp.nonant_idx)[root_slots]
+    S = len(efp.probs)
+    n = efp.n_per_scen
+    flat = (np.arange(S)[:, None] * n + cols_one[None, :]).ravel()
+    d_flat = np.asarray(efp.scaling.d_col)[flat]
+    return root_slots, flat, d_flat
+
+
 class ExtensiveForm:
     """Direct EF solve — API parity with ref:mpisppy/opt/ef.py:16-155.
 
@@ -177,23 +192,18 @@ class ExtensiveForm:
         ref:mpisppy/spopt.py:686-725).  Call before
         solve_extensive_form."""
         import dataclasses as _dc
-        root_slots = np.nonzero(self.ef.tree.slot_stage == 1)[0]
-        cols = np.asarray(self.ef.nonant_idx)[root_slots]
+        root_slots, flat, d_flat = root_fix_columns(self.ef)
         xhat_root = np.asarray(xhat_root, np.float64)
-        if xhat_root.shape[-1] != len(cols):
+        if xhat_root.shape[-1] != len(root_slots):
             raise ValueError(
                 f"xhat has {xhat_root.shape[-1]} values; the root "
-                f"stage has {len(cols)} nonant slots")
+                f"stage has {len(root_slots)} nonant slots")
         S = len(self.specs)
-        n = self.ef.n_per_scen
-        d = np.asarray(self.ef.scaling.d_col)
         l = np.array(np.asarray(self.ef.qp.l), np.float64)
         u = np.array(np.asarray(self.ef.qp.u), np.float64)
-        for s in range(S):
-            idx = s * n + cols
-            xs = xhat_root / d[idx]
-            l[idx] = xs
-            u[idx] = xs
+        xs = np.tile(xhat_root, S) / d_flat
+        l[flat] = xs
+        u[flat] = xs
         self.ef = _dc.replace(
             self.ef, qp=_dc.replace(
                 self.ef.qp,

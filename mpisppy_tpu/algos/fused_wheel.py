@@ -77,6 +77,15 @@ class FusedWheelOptions:
     # certificates are identical either way — budgets only change how
     # fast the warm solver tracks, never what gets certified.
     adapt_budgets: bool = True
+    # The Lagrangian plane does NOT lean by default: the outer bound's
+    # QUALITY (not just its certificate) gates termination, and on
+    # models with fast-moving duals (uc at rho=1000) a lean budget
+    # tracks well enough to certify while the bound value lags —
+    # measured: uc stalled at 2.5% with lag leaning vs 1.0% certified
+    # without, while sslp's headline was unaffected by full lag
+    # budgets.  Inner/heuristic planes keep leaning (their freshness
+    # only delays incumbent discovery, never weakens a published bound).
+    adapt_lag_budget: bool = False
     lean_lag_windows: int = 2
     lean_xhat_windows: int = 1
     lean_slam_windows: int = 1
@@ -201,25 +210,44 @@ def _scatter_scen(tree, sub, idx, S: int):
 
 
 def _tail_rescue(qp, st: pdhg.PDHGState, rp: Array, real: Array,
-                 wopts: FusedWheelOptions) -> pdhg.PDHGState:
+                 wopts: FusedWheelOptions,
+                 feas_tol: float) -> pdhg.PDHGState:
     """In-loop straggler sub-solve (see FusedWheelOptions.xhat_tail_k):
     top-k worst residual scenarios get a large extra budget at the
     tier-2 rescue profile on a gathered sub-batch, state scattered
-    back.  Runs inside the same jitted plane program."""
+    back.  Runs inside the same jitted plane program.
+
+    k is additionally capped at S/8: at small scenario counts a fixed
+    64 would re-solve most of the batch (observed: 64 of uc's 100
+    scenarios, ~0.7x the hub step, every exchange).  The whole
+    sub-solve is lax.cond-gated on some real scenario actually missing
+    tolerance, so exchanges whose main pass already cleared the gate
+    pay nothing."""
     S = st.omega.shape[0]
-    k = min(wopts.xhat_tail_k, S)
+    k = min(wopts.xhat_tail_k, max(8, S // 8), S)
     if k <= 0 or wopts.xhat_tail_windows <= 0:
         return st
-    _, idx = jax.lax.top_k(jnp.where(real, rp, -1.0), k)
-    sub_qp = _gather_qp(qp, idx, S)
-    sub_st = _gather_scen(st, idx, S)
-    topts = dataclasses.replace(
-        wopts.xhat_pdhg, omega0=0.03, restart_period=160)
-    sub_st = dataclasses.replace(
-        sub_st, omega=jnp.full_like(sub_st.omega, topts.omega0))
-    sub_st = pdhg.solve_fixed(sub_qp, wopts.xhat_tail_windows, topts,
-                              sub_st)
-    return _scatter_scen(st, sub_st, idx, S)
+
+    def run(st):
+        _, idx = jax.lax.top_k(jnp.where(real, rp, -1.0), k)
+        sub_qp = _gather_qp(qp, idx, S)
+        sub_st = _gather_scen(st, idx, S)
+        topts = dataclasses.replace(
+            wopts.xhat_pdhg, omega0=0.03, restart_period=160)
+        sub_st = dataclasses.replace(
+            sub_st, omega=jnp.full_like(sub_st.omega, topts.omega0))
+        sub_st = pdhg.solve_fixed(sub_qp, wopts.xhat_tail_windows, topts,
+                                  sub_st)
+        return _scatter_scen(st, sub_st, idx, S)
+
+    # engage well BELOW the publication gate: a scenario sitting just
+    # under feas_tol publishes with a first-order compensation of
+    # ~|y|'viol, which at loose tolerances can dwarf the bound itself
+    # (hydro: +37% inflation at rp~1e-3).  Polishing the tail to
+    # feas_tol/100 makes the compensation negligible, so the published
+    # inner bound is both valid AND tight.
+    needed = jnp.any(jnp.where(real, rp > 0.01 * feas_tol, False))
+    return jax.lax.cond(needed, run, lambda s: s, st)
 
 
 def _eval_step(batch: ScenarioBatch, cand: Array,
@@ -255,7 +283,7 @@ def _eval_step(batch: ScenarioBatch, cand: Array,
         # straggler sub-solve: x-hat plane only — the slam/shuffle
         # planes rotate candidates and must stay cheap
         rp0, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
-        st = _tail_rescue(qp, st, rp0, real, wopts)
+        st = _tail_rescue(qp, st, rp0, real, wopts, wopts.xhat_feas_tol)
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
     viol = boxqp.primal_residual(qp, st.x)
     obj = obj + jnp.sum(jnp.abs(st.y) * viol, axis=-1)
@@ -489,8 +517,10 @@ class FusedPH(ph_mod.PH):
         self._xhat_round_mode = "nearest"
         w = self.wheel_options
         stall = w.adapt_stall if w.adapt_budgets else (1 << 30)
+        lag_stall = stall if w.adapt_lag_budget else (1 << 30)
         self._budgets = {
-            "lag": _PlaneBudget(w.lag_windows, w.lean_lag_windows, stall),
+            "lag": _PlaneBudget(w.lag_windows, w.lean_lag_windows,
+                                lag_stall),
             "xhat": _PlaneBudget(w.xhat_windows, w.lean_xhat_windows,
                                  stall),
             "slam": _PlaneBudget(w.slam_windows, w.lean_slam_windows,

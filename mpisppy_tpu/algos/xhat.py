@@ -76,7 +76,10 @@ def _evaluate_warm_core(batch: ScenarioBatch, xhat: Array,
         solver,
         x=jnp.clip(solver.x, qp.l, qp.u))
     st = pdhg.solve(qp, opts, st)
+    # first-order infeasibility compensation — see _evaluate_core
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
+    obj = obj + jnp.sum(jnp.abs(st.y) * boxqp.primal_residual(qp, st.x),
+                        axis=-1)
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     real = batch.p > 0.0
     scen_ok = (rp <= feas_tol) & (st.status != pdhg.INFEASIBLE) \
@@ -173,7 +176,14 @@ def _evaluate_core(batch: ScenarioBatch, xhat: Array,
     opts = dataclasses.replace(opts, detect_infeas=True)
     st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
     # Original-space objective: scaled c,q absorb the column scaling.
+    # First-order infeasibility compensation (+E[sum |y| viol]): an
+    # rp-tolerant "feasible" x can undershoot the true recourse optimum
+    # by ~|y*|'viol, so the published inner value is pushed up by that
+    # margin — zero at exact feasibility (same rule as the fused
+    # planes, algos/fused_wheel._eval_step).
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
+    obj = obj + jnp.sum(jnp.abs(st.y) * boxqp.primal_residual(qp, st.x),
+                        axis=-1)
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     real = batch.p > 0.0
     # UNBOUNDED is excluded too: a frozen partially-converged iterate of

@@ -150,14 +150,23 @@ def _do_EF(cfg, module):
     return ef
 
 
-def _fuse_wheel(cfg, hub, spokes):
+def _fuse_wheel(cfg, hub, spokes, specs=None, tree=None):
     """Swap the PH hub for FusedPH and the fusable bound spokes
     (lagrangian / xhatxbar / slam / xhatshuffle) for their fused
     classes; everything else (cut providers, FWPH, reduced costs, ...)
-    stays a classic spoke on the hub's sync period."""
+    stays a classic spoke on the hub's sync period.
+
+    MULTISTAGE: the x̄ recourse planes fix EVERY stage's nonants, which
+    is structurally infeasible whenever a later-stage equality couples
+    nonants with stage randomness (hydro's reservoir balance — measured
+    recourse duals ~1e6); on trees deeper than two stages the x̄ spoke
+    maps to EFXhatInnerBound (root-fixed EF with intra-tree
+    nonanticipativity, the reference's xhatlooper stage2ef analog)
+    instead of the fused all-stage-fixed plane."""
     from mpisppy_tpu.algos import fused_wheel as fw
     from mpisppy_tpu.cylinders import spoke as spoke_mod
 
+    multistage = tree is not None and tree.num_stages > 2
     fusable = {
         spoke_mod.LagrangianOuterBound: spoke_mod.FusedLagrangianOuterBound,
         spoke_mod.XhatXbarInnerBound: spoke_mod.FusedXhatXbarInnerBound,
@@ -170,7 +179,13 @@ def _fuse_wheel(cfg, hub, spokes):
     out_spokes = []
     for sd in spokes:
         cls = sd["spoke_class"]
-        if cls in fusable:
+        if cls is spoke_mod.XhatXbarInnerBound and multistage \
+                and specs is not None:
+            out_spokes.append({
+                "spoke_class": spoke_mod.EFXhatInnerBound,
+                "opt_kwargs": {"options": {"specs": specs,
+                                           "tree": tree}}})
+        elif cls in fusable:
             present.add(cls)
             out_spokes.append({"spoke_class": fusable[cls],
                                "opt_kwargs": {"options": {}}})
@@ -308,7 +323,8 @@ def _do_decomp(cfg, module):
 
     if cfg.get("fused_wheel") and not cfg.get("lshaped_hub") \
             and not cfg.get("aph_hub"):
-        hub, spokes = _fuse_wheel(cfg, hub, spokes)
+        hub, spokes = _fuse_wheel(cfg, hub, spokes, specs=specs,
+                                  tree=batch.tree)
 
     wheel = WheelSpinner(hub, spokes)
     wheel.spin()

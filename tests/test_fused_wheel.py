@@ -166,7 +166,9 @@ def test_adaptive_budgets_engage_on_stalled_wheel():
                                      wheel_options=wopts),
                       ALL_FUSED_SPOKES).spin()
     budgets = ws.opt._budgets
-    assert budgets["lag"].windows() == wopts.lean_lag_windows
+    # the outer-bound plane does NOT lean by default (bound quality
+    # gates termination — see FusedWheelOptions.adapt_lag_budget)
+    assert budgets["lag"].windows() == wopts.lag_windows
     assert budgets["xhat"].windows() == wopts.lean_xhat_windows
     # bounds are still a certified bracket after running lean
     assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6

@@ -109,3 +109,49 @@ def test_hydro_larger_tree_builds():
     b = batch_mod.from_specs(specs, tree=tree)
     assert b.num_scenarios == 12
     assert tree.num_nodes == 5
+
+
+def test_ef_xhat_inner_bound_multistage():
+    """EFXhatInnerBound (root-fixed EF with intra-tree nonanticipativity)
+    must publish a value that upper-bounds the EF optimum; fixing ALL
+    stages' nonants at xbar is structurally infeasible on hydro (the
+    stage-2 reservoir balance couples fixed nonants with stochastic
+    inflow), which is exactly why this spoke exists."""
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.cylinders.spoke import EFOuterBound, EFXhatInnerBound
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs, tree = hydro_specs((3, 3))
+    batch = batch_mod.from_specs(specs, tree=tree)
+    efp = ef_mod.build_ef(specs, tree=tree)
+    # oracle: EF optimum via a tight direct solve
+    st = pdhg.solve(efp.qp, pdhg.PDHGOptions(tol=1e-7, max_iters=60_000,
+                                             dispatch_cap=0))
+    x = np.asarray(st.x) * np.asarray(efp.scaling.d_col)
+    S, n = len(efp.probs), efp.n_per_scen
+    xs = x.reshape(S, n)
+    opt = sum(float(efp.probs[s] * specs[s].c @ xs[s]) for s in range(S))
+
+    opts = ph_mod.PHOptions(default_rho=2.0, max_iterations=60,
+                            conv_thresh=0.0, subproblem_windows=8,
+                            pdhg=pdhg.PDHGOptions(tol=1e-6))
+    hub = {"hub_class": PHHub, "opt_class": fw.FusedPH,
+           "opt_kwargs": {"options": opts, "batch": batch},
+           "hub_kwargs": {"options": {"rel_gap": 1e-2}}}
+    spokes = [
+        {"spoke_class": EFOuterBound,
+         "opt_kwargs": {"options": {"ef_problem": efp, "n_windows": 30}}},
+        {"spoke_class": EFXhatInnerBound,
+         "opt_kwargs": {"options": {"ef_problem": efp, "n_windows": 30}}},
+    ]
+    ws = WheelSpinner(hub, spokes).spin()
+    inner, outer = ws.BestInnerBound, ws.BestOuterBound
+    assert np.isfinite(inner) and np.isfinite(outer)
+    # inner is a valid (first-order-compensated) upper bound on the
+    # optimum, outer a valid lower bound
+    slack = 5e-3 * max(1.0, abs(opt))
+    assert inner >= opt - slack
+    assert outer <= opt + slack
+    # and the pair certifies a tight bracket around the oracle
+    assert (inner - outer) / abs(inner) <= 1e-2 + 1e-6
