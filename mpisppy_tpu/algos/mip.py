@@ -101,6 +101,54 @@ def evaluate_mip(batch: ScenarioBatch, xhat: Array,
     }
 
 
+def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
+                          opts: BnBOptions = BnBOptions(),
+                          multistart: int = 24, lns_rounds: int = 60,
+                          verbose: bool = False) -> dict:
+    """evaluate_mip plus the heavy per-scenario incumbent polish for
+    FINAL-candidate certification: jitter-diversified multistart dives
+    (ops/bnb.dive_multistart) merged with the B&B incumbents, then
+    large-neighborhood repair (ops/bnb.lns_repair).  Measured on
+    sslp_15_45_5 at the published-optimal first stage: plain B&B
+    incumbents E=-257.6, +swap/LNS -259.4, diversified-LNS merge
+    reaches the per-scenario optima on 4 of 5 scenarios (scipy-MILP
+    ground truth -262.4)."""
+    base = evaluate_mip(batch, xhat, opts)
+    res = base["result"]
+    inc = jnp.asarray(res.inner)
+    x_inc = jnp.asarray(res.x)
+    feas_s = jnp.asarray(res.feasible)
+    qp = batch.with_fixed_nonants(jnp.asarray(base["xhat"]))
+    int_cols = jnp.asarray(_int_cols(batch))
+    if multistart > 0:
+        ms = bnb.dive_multistart(qp, batch.d_col, int_cols, opts,
+                                 K=multistart)
+        inc, x_inc, feas_s = bnb.merge_incumbents(inc, x_inc, feas_s,
+                                                  *ms)
+        if verbose:
+            print(f"[polish] multistart merge: {np.asarray(inc)}")
+    if lns_rounds > 0:
+        rep = bnb.lns_repair(qp, batch.d_col, int_cols, x_inc, inc,
+                             feas_s, opts, rounds=lns_rounds,
+                             destroy_frac=0.35, verbose=verbose)
+        if rep is not None:
+            inc, x_inc, feas_s = bnb.merge_incumbents(inc, x_inc,
+                                                      feas_s, *rep)
+    p = np.asarray(batch.p)
+    real = p > 0.0
+    feas = bool(np.all(np.where(real, np.asarray(feas_s), True)))
+    inner_s = np.asarray(inc)
+    value = float(np.sum(np.where(real, p * inner_s, 0.0))) if feas \
+        else float("inf")
+    out = dict(base)
+    out.update({"value": value, "per_scenario": inner_s,
+                "feasible": feas,
+                # the POLISHED per-scenario solutions achieving
+                # per_scenario/value (base["result"].x is pre-polish)
+                "x": np.asarray(x_inc)})
+    return out
+
+
 def evaluate_mip_many(batch: ScenarioBatch, xhats,
                       opts: BnBOptions = BnBOptions()) -> list[dict]:
     """Certified MIP inner bounds for K candidate first stages in ONE
@@ -253,6 +301,117 @@ def mip_dual_ascent_polyak(batch: ScenarioBatch, W, inner: float,
         if step <= 0.0:
             break
         W = W + step * g
+    return {"bound": best, "W": best_W, "history": hist}
+
+
+def mip_dual_bundle(batch: ScenarioBatch, W, inner: float,
+                    steps: int, opts: BnBOptions = BnBOptions(),
+                    target: float | None = None,
+                    trust0: float = 2.0,
+                    verbose: bool = False) -> dict:
+    """Trust-region BUNDLE method on the INTEGER Lagrangian dual — the
+    upgrade over mip_dual_ascent_polyak's subgradient steps, which
+    stall well short of the dual optimum (round 4: ~6 units above the
+    sslp_15_45 optima after 12 steps).
+
+    The dual D(W) = E_s[min_x f_s(x) + W_s'x_non] is concave; every
+    oracle call at W_k returns
+      * a CERTIFIED bound E_s[outer_s] (per-scenario B&B lower bounds,
+        valid at any truncation — this is what gets REPORTED), and
+      * a cut D(V) <= E_s[f_s(x_k,s) + V_s'x_non,k,s] from the
+        per-scenario incumbents x_k (min <= value at any feasible
+        point, so the cut is valid even when B&B is truncated).
+    The master maximizes the cutting-plane model over the PH-invariant
+    subspace (p-weighted node-mean of W = 0, which keeps D a valid
+    bound) inside an inf-norm trust region around the best W; it is a
+    ~(S*N)-variable LP solved on the host with scipy/HiGHS — a pure
+    direction-finder: ANY W it proposes yields a certified bound from
+    the oracle, so master quality never affects validity.
+
+    Serious steps (realized improvement) expand the trust region; null
+    steps shrink it.  Two-stage trees only (the mean-zero restriction
+    is applied per ROOT slot).  Returns {'bound','W','history'}."""
+    from scipy.optimize import linprog
+
+    if batch.tree.num_stages != 2:
+        raise ValueError("mip_dual_bundle: two-stage batches only")
+    W = np.asarray(jnp.asarray(W), np.float64)
+    p = np.asarray(batch.p, np.float64)
+    real = p > 0.0
+    S, N = W.shape
+    nv = S * N
+    cuts_a, cuts_b = [], []     # cut k: D(V) <= b_k + a_k . V
+    best, best_W = -np.inf, W.copy()
+    trust = float(trust0)
+    hist = []
+    center = W.copy()
+    for t in range(steps):
+        lag = lagrangian_mip_bound(batch, jnp.asarray(center + 0.0),
+                                   opts) if t == 0 else \
+            lagrangian_mip_bound(batch, jnp.asarray(W_try), opts)
+        Wk = center if t == 0 else W_try
+        L = lag["bound"]
+        hist.append(L)
+        serious = L > best + 1e-9 * max(1.0, abs(best))
+        if serious:
+            best, best_W = L, Wk.copy()
+            center = Wk.copy()
+            trust = min(trust * 1.6, 1e4)
+        else:
+            trust = max(trust * 0.5, 1e-5)
+        if verbose:
+            print(f"[bundle] step {t}: L={L:.6g} best={best:.6g} "
+                  f"trust={trust:.3g}")
+        if target is not None and best >= target:
+            break
+        res = lag["result"]
+        feas = np.asarray(res.feasible)
+        if bool(np.all(feas[real])):
+            x_non = np.asarray(res.x)[:, np.asarray(batch.nonant_idx)]
+            # res.inner is the LAGRANGIAN objective f_s(x_k)+W_k.x_non
+            # (the oracle folds W into c via with_nonant_linear_quad);
+            # the cut needs the RAW f_s(x_k), so subtract the penalty
+            # evaluated at the incumbent
+            wdot = np.sum(np.asarray(Wk) * x_non, axis=-1)
+            fvals = np.asarray(res.inner) - wdot
+            # cut: D(V) <= sum_s p_s f_s(x_k) + sum_s p_s V_s.x_non
+            a = (p[:, None] * x_non).reshape(nv)
+            b = float(np.sum(np.where(real, p * fvals, 0.0)))
+            cuts_a.append(a)
+            cuts_b.append(b)
+        if not cuts_a:
+            break
+        # master LP: max t  s.t. t <= b_k + a_k.V, mean-zero, trust box
+        nc = len(cuts_a)
+        # vars: [V (nv), t (1)]
+        c_lp = np.zeros(nv + 1)
+        c_lp[-1] = -1.0                      # maximize t
+        A_ub = np.zeros((nc, nv + 1))
+        b_ub = np.zeros(nc)
+        for k in range(nc):
+            A_ub[k, :nv] = -cuts_a[k]
+            A_ub[k, -1] = 1.0
+            b_ub[k] = cuts_b[k]
+        A_eq = np.zeros((N, nv + 1))
+        for j in range(N):
+            for s in range(S):
+                A_eq[j, s * N + j] = p[s]
+        b_eq = np.zeros(N)
+        lb = np.concatenate([(center - trust).reshape(nv), [-np.inf]])
+        ub = np.concatenate([(center + trust).reshape(nv), [np.inf]])
+        sol = linprog(c_lp, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq,
+                      bounds=np.stack([lb, ub], axis=1),
+                      method="highs")
+        if not sol.success:
+            if verbose:
+                print(f"[bundle] master failed: {sol.message}")
+            break
+        W_try = sol.x[:nv].reshape(S, N)
+        model_val = -sol.fun
+        # model agrees with reality -> the dual is (locally) maxed out
+        if model_val <= best + 1e-7 * max(1.0, abs(best)):
+            if trust <= 1e-4:
+                break
     return {"bound": best, "W": best_W, "history": hist}
 
 

@@ -87,6 +87,15 @@ class BnBOptions:
     # incumbents (0 disables); the pump handles the capacity-coupled
     # degenerate structures where rounding-based dives stall
     pump_rounds: int = 25
+    # dual-guided SOS1 swap-repair rounds on integral incumbents
+    # (0 disables): each round proposes ONE winner swap per scenario —
+    # the group move with the most negative reduced-cost delta read off
+    # the all-fixed LP's duals — evaluates it exactly with a warm
+    # re-solve, and keeps it only where the true objective improved.
+    # This closes the assignment-quality gap dive/B&B incumbents leave
+    # on SOS1-structured recourse (sslp_15_45_5 at the optimal first
+    # stage: -255.8 -> toward the true -262.4, measured round 5).
+    swap_rounds: int = 24
     # deterministic relative objective jitter for the NODE SOLVES ONLY:
     # breaks degenerate ties so the kernel's face-point iterates move
     # toward a unique vertex.  Bounds and objectives are always
@@ -173,8 +182,10 @@ def _solve_node(qp_node: BoxQP, x_warm: Array, y_warm: Array,
     Returns (solver_state, objective, certified_lb, primal_residual)."""
     lp = dataclasses.replace(lp_opts, detect_infeas=True)
     if jitter > 0.0:
+        # PER-ROW draws: tiled multistart copies of the same scenario
+        # (dive_multistart) get different tie-breaks from the same key
         u = jax.random.uniform(jax.random.PRNGKey(17),
-                               (qp_node.c.shape[-1],), qp_node.c.dtype)
+                               qp_node.c.shape, qp_node.c.dtype)
         cscale = jnp.maximum(jnp.mean(jnp.abs(qp_node.c), axis=-1,
                                       keepdims=True), 1.0)
         qp_solve = dataclasses.replace(
@@ -680,7 +691,8 @@ def dive(qp: BoxQP, d_col: Array, int_cols: Array,
          opts: BnBOptions = BnBOptions(),
          lo: Array | None = None, hi: Array | None = None,
          x_warm: Array | None = None, y_warm: Array | None = None,
-         omega: Array | None = None, Lnorm: Array | None = None):
+         omega: Array | None = None, Lnorm: Array | None = None,
+         sos1=None):
     """Fix-and-round dive to one integer-feasible point per scenario
     (host loop over jitted rounds).  Returns (value (S,), x (S,n) orig,
     feasible (S,), warm) where warm = (x, y, omega, Lnorm) for reuse;
@@ -705,8 +717,10 @@ def dive(qp: BoxQP, d_col: Array, int_cols: Array,
     def all_fixed():
         return bool(np.all(np.asarray(lo) == np.asarray(hi)))
 
-    # SOS1-like assignment rows round winner-take-all (detected once)
-    sos1 = detect_sos1_groups(qp, d_col, int_cols)
+    # SOS1-like assignment rows round winner-take-all (detected once;
+    # repeated callers — lns_repair — pass the cached detection in)
+    if sos1 is None:
+        sos1 = detect_sos1_groups(qp, d_col, int_cols)
 
     prev_fixed = -1
     for _ in range(max(1, opts.dive_rounds)):
@@ -744,6 +758,236 @@ def dive(qp: BoxQP, d_col: Array, int_cols: Array,
     return value, x_orig, feas, (x_warm, y_warm, omega, Lnorm)
 
 
+@partial(jax.jit, static_argnames=("opts",))
+def _swap_round(qp: BoxQP, d_col: Array, int_cols: Array,
+                xi: Array, hi_root: Array, groups: Array, active: Array,
+                obj_cur: Array, feas_cur: Array,
+                x_cur: Array, y_cur: Array, omega: Array, Lnorm: Array,
+                opts: BnBOptions):
+    """One dual-guided SOS1 swap per scenario (see
+    BnBOptions.swap_rounds).  `xi` is the (S, nI) integral point in
+    ORIGINAL space, `x_cur`/`y_cur` the scaled primal-dual pair of its
+    all-fixed LP solve; accepted moves replace the state, rejected
+    moves leave it bit-identical."""
+    S = xi.shape[0]
+    d_full = jnp.broadcast_to(d_col, x_cur.shape)
+    # per-unit-original reduced costs off the CURRENT duals: moving a
+    # one-hot winner from column w to column m changes the objective by
+    # ~ rc[m]/d[m] - rc[w]/d[w] (the group's own equality-row dual
+    # contributes equally to every member, so comparisons are clean)
+    rc = qp.c + qp.q * x_cur + qp.rmatvec(y_cur)
+    score = (rc / d_full)[:, int_cols]                    # (S, nI)
+    G, L = groups.shape
+    gidx = jnp.where(groups < 0, 0, groups)               # (G, L)
+    valid = (groups >= 0)[None]                           # (1, G, L)
+    srange = jnp.arange(S)
+    xg = jnp.where(valid, xi[:, gidx], 0.0)               # (S, G, L)
+    sg = jnp.where(valid, score[:, gidx], jnp.inf)
+    allowed = valid & (hi_root[:, gidx] > 0.5)
+    is_winner = xg > 0.5
+    win_score = jnp.sum(jnp.where(is_winner, sg, 0.0), axis=-1)
+    alt = jnp.where(is_winner | ~allowed, jnp.inf, sg)    # (S, G, L)
+    alt_best = jnp.min(alt, axis=-1)
+    has_winner = jnp.any(is_winner & valid, axis=-1)      # (S, G)
+    delta = jnp.where(active & has_winner & jnp.isfinite(alt_best),
+                      alt_best - win_score, jnp.inf)
+    gstar = jnp.argmin(delta, axis=-1)                    # (S,)
+    can = jnp.isfinite(jnp.min(delta, axis=-1)) & feas_cur
+    gsel = gidx[gstar]                                    # (S, L)
+    vsel = (groups >= 0)[gstar]
+    xg_sel = jnp.where(vsel, xi[srange[:, None], gsel], 0.0)
+    win_col = jnp.take_along_axis(
+        gsel, jnp.argmax(xg_sel, axis=-1)[:, None], axis=-1)[:, 0]
+    alt_sel = alt[srange, gstar]
+    alt_col = jnp.take_along_axis(
+        gsel, jnp.argmin(alt_sel, axis=-1)[:, None], axis=-1)[:, 0]
+    step = jnp.where(can, 1.0, 0.0)
+    xi_try = xi.at[srange, win_col].add(-step)
+    xi_try = xi_try.at[srange, alt_col].add(step)
+
+    qpt = _node_qp(qp, d_col, int_cols, xi_try, xi_try)
+    sol2, obj2, _, rp2 = _solve_node(qpt, x_cur, y_cur, opts.lp,
+                                     omega, Lnorm)
+    feas2 = (rp2 <= opts.feas_tol) & (sol2.status != pdhg.INFEASIBLE) \
+        & (sol2.status != pdhg.UNBOUNDED)
+    eps = 1e-6 * jnp.maximum(1.0, jnp.abs(obj_cur))
+    improve = can & feas2 & (obj2 < obj_cur - eps)
+    imp_c = improve[:, None]
+    return (jnp.where(imp_c, xi_try, xi),
+            jnp.where(improve, obj2, obj_cur),
+            feas_cur | improve,
+            jnp.where(imp_c, sol2.x, x_cur),
+            jnp.where(imp_c, sol2.y, y_cur),
+            jnp.where(improve, sol2.omega, omega),
+            improve)
+
+
+def sos1_swap_repair(qp: BoxQP, d_col: Array, int_cols: Array,
+                     x_inc_orig: Array, feas: Array,
+                     opts: BnBOptions,
+                     warm=None, sos1=None, verbose: bool = False):
+    """Polish integral incumbents by dual-guided SOS1 winner swaps.
+
+    x_inc_orig: (S, n) incumbent points in ORIGINAL space (integer
+    columns integral where `feas`).  Returns (value (S,), x_orig,
+    feasible) with per-scenario improvements only (never regressions).
+    No-op (returns None) when the problem has no SOS1 groups or
+    swap_rounds == 0."""
+    if opts.swap_rounds <= 0:
+        return None
+    if sos1 is None:
+        sos1 = detect_sos1_groups(qp, d_col, int_cols)
+    groups, active = sos1
+    if groups is None:
+        return None
+    int_np = np.asarray(int_cols)
+    _, hi_root = _root_bounds(qp, d_col, int_np)
+    hi_root = jnp.asarray(hi_root, qp.c.dtype)
+    xi = jnp.round(jnp.asarray(x_inc_orig)[:, int_np])
+    S, n = qp.c.shape
+    dt = qp.c.dtype
+    d_full = jnp.broadcast_to(d_col, (S, n))
+    if warm is not None:
+        x_w, y_w, omega, Lnorm = warm
+    else:
+        x_w = jnp.asarray(x_inc_orig, dt) / d_full
+        y_w = jnp.zeros((S, qp.m), dt)
+        omega = Lnorm = None
+    # evaluate the incumbents once (all integers fixed) for the
+    # baseline objective and duals the first proposals read
+    qpn = _node_qp(qp, d_col, int_cols, xi, xi)
+    sol, obj, _, rp = _solve_node(qpn, x_w, y_w, opts.lp, omega, Lnorm)
+    feas_cur = jnp.asarray(feas) & (rp <= opts.feas_tol) \
+        & (sol.status != pdhg.INFEASIBLE) \
+        & (sol.status != pdhg.UNBOUNDED)
+    x_cur, y_cur, om = sol.x, sol.y, sol.omega
+    Ln = sol.Lnorm
+    for r in range(opts.swap_rounds):
+        xi, obj, feas_cur, x_cur, y_cur, om, moved = _swap_round(
+            qp, d_col, int_cols, xi, hi_root, groups, active,
+            obj, feas_cur, x_cur, y_cur, om, Ln, opts)
+        if not bool(np.any(np.asarray(moved))):
+            break
+        if verbose and (r + 1) % 8 == 0:
+            print(f"[swap] round {r + 1}: obj={np.asarray(obj)}")
+    x_orig = x_cur * d_full
+    x_orig = x_orig.at[:, int_np].set(xi)
+    return (jnp.where(feas_cur, obj, jnp.inf), x_orig, feas_cur)
+
+
+def merge_incumbents(inc, x_inc, feas, cand_val, cand_x, cand_feas):
+    """Accept-only-improvements merge of candidate incumbents into the
+    running best: the single place the invariant lives (a candidate
+    counts only where IT is feasible and strictly better than the
+    current FEASIBLE value, infeasible current = +inf)."""
+    better = jnp.where(cand_feas, cand_val, jnp.inf) \
+        < jnp.where(feas, inc, jnp.inf)
+    return (jnp.where(better, cand_val, inc),
+            jnp.where(better[:, None], cand_x, x_inc),
+            feas | (cand_feas & better))
+
+
+def dive_multistart(qp: BoxQP, d_col: Array, int_cols: Array,
+                    opts: BnBOptions = BnBOptions(), K: int = 16):
+    """K jitter-diversified dives per scenario in ONE batched program —
+    batching the restarts is the TPU answer to a MIP heuristic's
+    random-restart loop.  Each copy solves the SAME scenario with a
+    different deterministic objective perturbation (vertex
+    tie-breaking only; values are always evaluated against the true
+    costs), and the per-scenario best integral point wins.  Returns
+    (value (S,), x (S, n) orig, feasible (S,))."""
+    S, n = qp.c.shape
+
+    def tile(x, nd):
+        if hasattr(x, "vals"):  # EllMatrix (same convention as
+            # mip.evaluate_mip_many's tileS)
+            return dataclasses.replace(x, vals=tile(x.vals, nd))
+        if getattr(x, "ndim", 0) != nd:
+            return x
+        return jnp.tile(x, (K,) + (1,) * (nd - 1))
+
+    qpK = dataclasses.replace(
+        qp, c=tile(qp.c, 2), q=tile(qp.q, 2), A=tile(qp.A, 3),
+        bl=tile(qp.bl, 2), bu=tile(qp.bu, 2), l=tile(qp.l, 2),
+        u=tile(qp.u, 2))
+    dK = d_col
+    if getattr(d_col, "ndim", 1) == 2:
+        dK = jnp.tile(d_col, (K, 1))
+    o2 = dataclasses.replace(opts, jitter=max(opts.jitter, 1e-3))
+    val, x, feas, _ = dive(qpK, dK, int_cols, o2)
+    val = jnp.where(feas, val, jnp.inf).reshape(K, S)
+    x = x.reshape(K, S, n)
+    k_best = jnp.argmin(val, axis=0)                      # (S,)
+    srange = jnp.arange(S)
+    return (val[k_best, srange], x[k_best, srange],
+            jnp.isfinite(val[k_best, srange]))
+
+
+def lns_repair(qp: BoxQP, d_col: Array, int_cols: Array,
+               x_inc_orig: Array, value0: Array, feas0: Array,
+               opts: BnBOptions = BnBOptions(),
+               rounds: int = 16, destroy_frac: float = 0.25,
+               seed: int = 7, verbose: bool = False):
+    """Large-neighborhood polish of integral incumbents: per round,
+    UNFIX a random per-scenario subset of SOS1 groups (the rest stay
+    pinned at the incumbent) and re-dive warm, accepting per-scenario
+    strict improvements only.
+
+    Single dual-guided swaps (sos1_swap_repair) stall on
+    capacity-coupled assignment structure — improving moves need
+    chains (client A leaves server j so client B fits), which the
+    destroy-and-re-dive neighborhood reaches.  Deterministic via
+    `seed`.  Meant for FINAL-candidate certification polish, not the
+    per-node hot path (each round costs a partial dive).  Returns
+    (value, x_orig, feasible) or None when structureless."""
+    sos1 = detect_sos1_groups(qp, d_col, int_cols)
+    groups, active = sos1
+    if groups is None or rounds <= 0:
+        return None
+    int_np = np.asarray(int_cols)
+    lo_root, hi_root = _root_bounds(qp, d_col, int_np)
+    xi = np.round(np.asarray(x_inc_orig)[:, int_np])
+    best_val = np.array(np.asarray(value0), np.float64)
+    best_x = np.array(np.asarray(x_inc_orig), np.float64)
+    feas = np.array(np.asarray(feas0), bool)
+    groups_np = np.asarray(groups)
+    active_np = np.asarray(active)
+    G, L = groups_np.shape
+    S, nI = xi.shape
+    membership = np.zeros((G, nI), bool)
+    for g in range(G):
+        membership[g, groups_np[g][groups_np[g] >= 0]] = True
+    rng = np.random.default_rng(seed)
+    dt = qp.c.dtype
+    warm_omega = warm_L = None   # captured from the first dive
+    for r in range(rounds):
+        destroyed = (rng.random((S, G)) < destroy_frac) & active_np
+        unfix = destroyed @ membership                    # (S, nI) bool
+        cur = np.where(feas[:, None], xi, lo_root)        # infeasible:
+        lo = np.where(unfix | ~feas[:, None], lo_root, cur)  # full re-dive
+        hi = np.where(unfix | ~feas[:, None], hi_root, cur)
+        val, x_new, f_new, warm = dive(
+            qp, d_col, int_cols, opts,
+            lo=jnp.asarray(lo, dt), hi=jnp.asarray(hi, dt),
+            omega=warm_omega, Lnorm=warm_L, sos1=sos1)
+        if warm_L is None:
+            warm_omega, warm_L = warm[2], warm[3]
+        val = np.asarray(val)
+        x_new = np.asarray(x_new)
+        f_new = np.asarray(f_new)
+        eps = 1e-6 * np.maximum(1.0, np.abs(best_val))
+        better = f_new & (val < np.where(feas, best_val - eps, np.inf))
+        if np.any(better):
+            best_val = np.where(better, val, best_val)
+            best_x = np.where(better[:, None], x_new, best_x)
+            feas = feas | better
+            xi = np.round(best_x[:, int_np])
+        if verbose and (r + 1) % 4 == 0:
+            print(f"[lns] round {r + 1}: {best_val}")
+    return (jnp.asarray(np.where(feas, best_val, np.inf), dt),
+            jnp.asarray(best_x, dt), jnp.asarray(feas))
+
+
 def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
               opts: BnBOptions = BnBOptions(),
               x_warm: Array | None = None, y_warm: Array | None = None,
@@ -779,6 +1023,15 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         if verbose:
             print(f"[bnb] pump incumbents: {np.asarray(p_val)}")
 
+    sos1 = detect_sos1_groups(qp, d_col, int_cols)
+    rep = sos1_swap_repair(qp, d_col, int_cols, x_inc, feas, opts,
+                           warm=(dive_x, dive_y, omega, Lnorm),
+                           sos1=sos1, verbose=verbose)
+    if rep is not None:
+        inc, x_inc, feas = merge_incumbents(inc, x_inc, feas, *rep)
+        if verbose:
+            print(f"[bnb] swap-repaired incumbents: {np.asarray(inc)}")
+
     lo0, hi0 = _root_bounds(qp, d_col, np.asarray(int_cols))
     pool_lo = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
         jnp.asarray(lo0, dt))
@@ -807,6 +1060,17 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         if verbose and (r + 1) % 25 == 0:
             print(f"[bnb] round {r + 1}: inc={np.asarray(st.incumbent)} "
                   f"outer={np.asarray(st.outer)}")
+
+    # final polish: B&B rounds may have found new incumbents the
+    # swap-repair has not seen yet
+    rep = sos1_swap_repair(
+        qp, d_col, int_cols, st.x_inc, jnp.isfinite(st.incumbent), opts,
+        warm=(st.x_warm, st.y_warm, st.omega_warm, st.Lnorm),
+        sos1=sos1, verbose=verbose)
+    if rep is not None:
+        new_inc, new_x, _ = merge_incumbents(
+            st.incumbent, st.x_inc, jnp.isfinite(st.incumbent), *rep)
+        st = dataclasses.replace(st, incumbent=new_inc, x_inc=new_x)
 
     inner = st.incumbent
     # A scenario that exhausted its pool with no incumbent and no open

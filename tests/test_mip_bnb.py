@@ -247,3 +247,51 @@ def test_sslp_15_45_5_certified_gap_slow():
     assert np.isfinite(res.inner)
     assert res.outer <= -262.4 + 0.5 and res.inner >= -262.4 - 0.5, res
     assert res.gap <= 0.005, res
+
+
+def test_polish_pipeline_improves_and_stays_valid(small_sslp_batch):
+    """evaluate_mip_polished (multistart dives + LNS merge) must never
+    regress below evaluate_mip and must stay a valid upper bound: every
+    per-scenario value >= the per-scenario oracle MIP optimum."""
+    from mpisppy_tpu.algos import mip
+    specs, batch = small_sslp_batch
+    xhat = np.ones(len(np.asarray(batch.nonant_idx)))
+    opts = BnBOptions(max_rounds=60, pool_size=32)
+    base = mip.evaluate_mip(batch, jnp.asarray(xhat), opts)
+    pol = mip.evaluate_mip_polished(batch, jnp.asarray(xhat), opts,
+                                    multistart=6, lns_rounds=6)
+    assert pol["feasible"]
+    assert pol["value"] <= base["value"] + 1e-6
+    # per-scenario oracle with the first stage fixed
+    for s, sp in enumerate(specs):
+        l = np.asarray(sp.l, float).copy()
+        u = np.asarray(sp.u, float).copy()
+        ni = np.asarray(sp.nonant_idx)
+        l[ni] = xhat
+        u[ni] = xhat
+        integer = np.zeros(len(sp.c), bool)
+        integer[np.asarray(sp.integer)] = True
+        ref = milp_oracle(np.asarray(sp.c, float), np.asarray(sp.A, float),
+                          np.asarray(sp.bl, float),
+                          np.asarray(sp.bu, float), l, u, integer)
+        assert pol["per_scenario"][s] >= ref.fun - 1e-3 * (1 + abs(ref.fun))
+
+
+def test_dive_multistart_and_lns_shapes(small_sslp_batch):
+    from mpisppy_tpu.ops import bnb as bnb_mod
+    specs, batch = small_sslp_batch
+    xhat = jnp.ones(len(np.asarray(batch.nonant_idx)))
+    qp = batch.with_fixed_nonants(xhat)
+    int_cols = jnp.asarray(
+        np.nonzero(np.asarray(batch.integer_full))[0].astype(np.int32))
+    opts = BnBOptions(max_rounds=10)
+    val, x, feas = bnb_mod.dive_multistart(qp, batch.d_col, int_cols,
+                                           opts, K=4)
+    S, n = qp.c.shape
+    assert val.shape == (S,) and x.shape == (S, n)
+    rep = bnb_mod.lns_repair(qp, batch.d_col, int_cols, x, val, feas,
+                             opts, rounds=3)
+    if rep is not None:
+        rv, rx, rf = rep
+        # never a regression
+        assert bool(jnp.all(jnp.where(feas, rv <= val + 1e-6, True)))
