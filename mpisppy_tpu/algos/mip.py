@@ -39,6 +39,19 @@ from mpisppy_tpu.ops.bnb import BnBOptions
 Array = jnp.ndarray
 
 
+def _aggregate_inner(per_scenario, feas_s, p):
+    """(value, feasible): the all-real-scenarios-feasible gate and
+    p-weighted expectation shared by evaluate_mip and its polished
+    variant (one place for the padded-scenario and inf-sentinel
+    rules)."""
+    real = p > 0.0
+    feas = bool(np.all(np.where(real, np.asarray(feas_s), True)))
+    inner_s = np.asarray(per_scenario)
+    value = float(np.sum(np.where(real, p * inner_s, 0.0))) if feas \
+        else float("inf")
+    return value, feas, inner_s
+
+
 def _int_cols(batch: ScenarioBatch) -> np.ndarray:
     cols = np.nonzero(np.asarray(batch.integer_full))[0]
     if cols.size == 0:
@@ -85,10 +98,7 @@ def evaluate_mip(batch: ScenarioBatch, xhat: Array,
     res = bnb.solve_mip(qp, batch.d_col, _int_cols(batch), opts)
     p = np.asarray(batch.p)
     real = p > 0.0
-    feas = bool(np.all(np.where(real, np.asarray(res.feasible), True)))
-    inner_s = np.asarray(res.inner)
-    value = float(np.sum(np.where(real, p * inner_s, 0.0))) if feas \
-        else float("inf")
+    value, feas, inner_s = _aggregate_inner(res.inner, res.feasible, p)
     # the recourse B&B's outer bounds bracket the true E[f(xhat)]
     lower = float(np.sum(np.where(real, p * np.asarray(res.outer), 0.0)))
     return {
@@ -120,9 +130,10 @@ def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
     feas_s = jnp.asarray(res.feasible)
     qp = batch.with_fixed_nonants(jnp.asarray(base["xhat"]))
     int_cols = jnp.asarray(_int_cols(batch))
+    sos1 = bnb.detect_sos1_groups(qp, batch.d_col, int_cols)
     if multistart > 0:
         ms = bnb.dive_multistart(qp, batch.d_col, int_cols, opts,
-                                 K=multistart)
+                                 K=multistart, sos1=sos1)
         inc, x_inc, feas_s = bnb.merge_incumbents(inc, x_inc, feas_s,
                                                   *ms)
         if verbose:
@@ -130,16 +141,13 @@ def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
     if lns_rounds > 0:
         rep = bnb.lns_repair(qp, batch.d_col, int_cols, x_inc, inc,
                              feas_s, opts, rounds=lns_rounds,
-                             destroy_frac=0.35, verbose=verbose)
+                             destroy_frac=0.35, sos1=sos1,
+                             verbose=verbose)
         if rep is not None:
             inc, x_inc, feas_s = bnb.merge_incumbents(inc, x_inc,
                                                       feas_s, *rep)
-    p = np.asarray(batch.p)
-    real = p > 0.0
-    feas = bool(np.all(np.where(real, np.asarray(feas_s), True)))
-    inner_s = np.asarray(inc)
-    value = float(np.sum(np.where(real, p * inner_s, 0.0))) if feas \
-        else float("inf")
+    value, feas, inner_s = _aggregate_inner(inc, feas_s,
+                                            np.asarray(batch.p))
     out = dict(base)
     out.update({"value": value, "per_scenario": inner_s,
                 "feasible": feas,
@@ -352,7 +360,11 @@ def mip_dual_bundle(batch: ScenarioBatch, W, inner: float,
         Wk = center if t == 0 else W_try
         L = lag["bound"]
         hist.append(L)
-        serious = L > best + 1e-9 * max(1.0, abs(best))
+        # plain > when best is still -inf (the relative-eps form is
+        # NaN-poisoned at -inf: -inf + inf = nan, and L > nan is False
+        # forever)
+        serious = (L > best if not np.isfinite(best)
+                   else L > best + 1e-9 * max(1.0, abs(best)))
         if serious:
             best, best_W = L, Wk.copy()
             center = Wk.copy()
@@ -409,9 +421,10 @@ def mip_dual_bundle(batch: ScenarioBatch, W, inner: float,
         W_try = sol.x[:nv].reshape(S, N)
         model_val = -sol.fun
         # model agrees with reality -> the dual is (locally) maxed out
-        if model_val <= best + 1e-7 * max(1.0, abs(best)):
-            if trust <= 1e-4:
-                break
+        if np.isfinite(best) \
+                and model_val <= best + 1e-7 * max(1.0, abs(best)) \
+                and trust <= 1e-4:
+            break
     return {"bound": best, "W": best_W, "history": hist}
 
 

@@ -888,7 +888,8 @@ def merge_incumbents(inc, x_inc, feas, cand_val, cand_x, cand_feas):
 
 
 def dive_multistart(qp: BoxQP, d_col: Array, int_cols: Array,
-                    opts: BnBOptions = BnBOptions(), K: int = 16):
+                    opts: BnBOptions = BnBOptions(), K: int = 16,
+                    sos1=None):
     """K jitter-diversified dives per scenario in ONE batched program —
     batching the restarts is the TPU answer to a MIP heuristic's
     random-restart loop.  Each copy solves the SAME scenario with a
@@ -914,7 +915,12 @@ def dive_multistart(qp: BoxQP, d_col: Array, int_cols: Array,
     if getattr(d_col, "ndim", 1) == 2:
         dK = jnp.tile(d_col, (K, 1))
     o2 = dataclasses.replace(opts, jitter=max(opts.jitter, 1e-3))
-    val, x, feas, _ = dive(qpK, dK, int_cols, o2)
+    if sos1 is not None and sos1[0] is not None:
+        groups, active = sos1
+        sos1K = (groups, jnp.tile(active, (K, 1)))
+    else:
+        sos1K = sos1
+    val, x, feas, _ = dive(qpK, dK, int_cols, o2, sos1=sos1K)
     val = jnp.where(feas, val, jnp.inf).reshape(K, S)
     x = x.reshape(K, S, n)
     k_best = jnp.argmin(val, axis=0)                      # (S,)
@@ -927,7 +933,7 @@ def lns_repair(qp: BoxQP, d_col: Array, int_cols: Array,
                x_inc_orig: Array, value0: Array, feas0: Array,
                opts: BnBOptions = BnBOptions(),
                rounds: int = 16, destroy_frac: float = 0.25,
-               seed: int = 7, verbose: bool = False):
+               seed: int = 7, sos1=None, verbose: bool = False):
     """Large-neighborhood polish of integral incumbents: per round,
     UNFIX a random per-scenario subset of SOS1 groups (the rest stay
     pinned at the incumbent) and re-dive warm, accepting per-scenario
@@ -940,7 +946,8 @@ def lns_repair(qp: BoxQP, d_col: Array, int_cols: Array,
     `seed`.  Meant for FINAL-candidate certification polish, not the
     per-node hot path (each round costs a partial dive).  Returns
     (value, x_orig, feasible) or None when structureless."""
-    sos1 = detect_sos1_groups(qp, d_col, int_cols)
+    if sos1 is None:
+        sos1 = detect_sos1_groups(qp, d_col, int_cols)
     groups, active = sos1
     if groups is None or rounds <= 0:
         return None
@@ -1006,8 +1013,10 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
     nI = int(int_cols.shape[0])
     P = opts.pool_size
 
+    sos1 = detect_sos1_groups(qp, d_col, int_cols)
     inc, x_inc, feas, warm = dive(qp, d_col, int_cols, opts,
-                                  x_warm=x_warm, y_warm=y_warm)
+                                  x_warm=x_warm, y_warm=y_warm,
+                                  sos1=sos1)
     dive_x, dive_y, omega, Lnorm = warm
     if verbose and bool(np.any(np.asarray(feas))):
         v = np.asarray(inc)
@@ -1016,14 +1025,11 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         p_val, p_x, p_feas = feasibility_pump(
             qp, d_col, int_cols, opts, rounds=opts.pump_rounds,
             x_warm=dive_x, y_warm=dive_y, omega=omega, Lnorm=Lnorm)
-        better = p_val < inc
-        inc = jnp.where(better, p_val, inc)
-        x_inc = jnp.where(better[:, None], p_x, x_inc)
-        feas = feas | p_feas
+        inc, x_inc, feas = merge_incumbents(inc, x_inc, feas,
+                                            p_val, p_x, p_feas)
         if verbose:
             print(f"[bnb] pump incumbents: {np.asarray(p_val)}")
 
-    sos1 = detect_sos1_groups(qp, d_col, int_cols)
     rep = sos1_swap_repair(qp, d_col, int_cols, x_inc, feas, opts,
                            warm=(dive_x, dive_y, omega, Lnorm),
                            sos1=sos1, verbose=verbose)
