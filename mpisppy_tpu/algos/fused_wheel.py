@@ -68,7 +68,11 @@ class FusedWheelOptions:
     # VMEM-resident state, and async dispatch already hides the ~6 ms
     # tunnel latency.  Split mode is also what makes per-plane adaptive
     # budgets cheap (one small recompile per plane/budget pair).
-    split_dispatch: bool = True
+    # None = AUTO: split at >=512 scenarios; below that per-dispatch
+    # overhead dominates device time and the monolithic program wins
+    # (uc at S=100: 0.33 s/iter monolithic vs 0.72 s/iter split,
+    # measured).  True/False forces.
+    split_dispatch: bool | None = None
     # Adaptive budgets (split mode only): a plane runs its full budget
     # until it has CERTIFIED (dual-residual / feasibility gate) for
     # `adapt_stall` consecutive exchanges — its warm solver is then
@@ -117,6 +121,9 @@ class FusedWheelOptions:
     xhat_pdhg: pdhg.PDHGOptions = pdhg.PDHGOptions(
         tol=1e-6, omega0=0.1, restart_period=80)
     xhat_feas_tol: float = 1e-3
+    # max first-order infeasibility compensation (relative to the
+    # value) a published inner bound may carry — see _eval_step
+    xhat_comp_tol: float = 2e-3
 
 
 @partial(
@@ -240,13 +247,14 @@ def _tail_rescue(qp, st: pdhg.PDHGState, rp: Array, real: Array,
                                   sub_st)
         return _scatter_scen(st, sub_st, idx, S)
 
-    # engage well BELOW the publication gate: a scenario sitting just
-    # under feas_tol publishes with a first-order compensation of
-    # ~|y|'viol, which at loose tolerances can dwarf the bound itself
-    # (hydro: +37% inflation at rp~1e-3).  Polishing the tail to
-    # feas_tol/100 makes the compensation negligible, so the published
-    # inner bound is both valid AND tight.
-    needed = jnp.any(jnp.where(real, rp > 0.01 * feas_tol, False))
+    # engage only while some scenario actually MISSES the publication
+    # gate — the tail exists to converge the straggler recourse LPs
+    # that block all-scenario feasibility (sslp-10k), not to polish
+    # already-feasible solves.  An always-on variant (engage at
+    # feas_tol/100) cost uc 0.4 s/iteration for identical bounds,
+    # measured: 427 iterations certified the same outer/inner with the
+    # tail never improving anything.
+    needed = jnp.any(jnp.where(real, rp > feas_tol, False))
     return jax.lax.cond(needed, run, lambda s: s, st)
 
 
@@ -286,7 +294,8 @@ def _eval_step(batch: ScenarioBatch, cand: Array,
         st = _tail_rescue(qp, st, rp0, real, wopts, wopts.xhat_feas_tol)
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
     viol = boxqp.primal_residual(qp, st.x)
-    obj = obj + jnp.sum(jnp.abs(st.y) * viol, axis=-1)
+    comp = jnp.sum(jnp.abs(st.y) * viol, axis=-1)
+    obj = obj + comp
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     bad_status = (st.status == pdhg.INFEASIBLE) \
         | (st.status == pdhg.UNBOUNDED)
@@ -295,6 +304,16 @@ def _eval_step(batch: ScenarioBatch, cand: Array,
     dead = jnp.any(jnp.where(real, bad_status, False))
     value = jnp.where(feas, batch.expectation(obj),
                       jnp.asarray(jnp.inf, obj.dtype))
+    # TIGHTNESS gate: the compensation is first-order, so a value whose
+    # compensation is a material fraction of the bound itself is not
+    # trustworthy (hydro measured +37% at stiff duals).  Feasible-but-
+    # loose evaluations stay unpublished until the warm solver (or the
+    # tail rescue, which engages on rp > feas_tol) tightens them.
+    ecomp = batch.expectation(comp)
+    tight = ecomp <= wopts.xhat_comp_tol * jnp.maximum(1.0,
+                                                       jnp.abs(value))
+    feas = feas & tight
+    value = jnp.where(feas, value, jnp.asarray(jnp.inf, obj.dtype))
     return st, value, feas, dead
 
 
@@ -581,7 +600,10 @@ class FusedPH(ph_mod.PH):
         wopts = self.wheel_options
         p = max(1, int(wopts.spoke_period))
         spoke_iter = p <= 1 or (self._iter % p) == 0
-        if wopts.split_dispatch:
+        split = wopts.split_dispatch
+        if split is None:
+            split = self.batch.num_real >= 512
+        if split:
             self.wstate = self._iterk_split(wopts, sid, spoke_iter)
         else:
             w = wopts

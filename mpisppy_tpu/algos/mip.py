@@ -114,6 +114,7 @@ def evaluate_mip(batch: ScenarioBatch, xhat: Array,
 def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
                           opts: BnBOptions = BnBOptions(),
                           multistart: int = 24, lns_rounds: int = 60,
+                          base: dict | None = None,
                           verbose: bool = False) -> dict:
     """evaluate_mip plus the heavy per-scenario incumbent polish for
     FINAL-candidate certification: jitter-diversified multistart dives
@@ -123,7 +124,10 @@ def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
     incumbents E=-257.6, +swap/LNS -259.4, diversified-LNS merge
     reaches the per-scenario optima on 4 of 5 scenarios (scipy-MILP
     ground truth -262.4)."""
-    base = evaluate_mip(batch, xhat, opts)
+    # callers holding a fresh evaluate_mip dict for the SAME xhat can
+    # pass it as `base` and skip the (expensive) internal re-solve
+    if base is None:
+        base = evaluate_mip(batch, xhat, opts)
     res = base["result"]
     inc = jnp.asarray(res.inner)
     x_inc = jnp.asarray(res.x)

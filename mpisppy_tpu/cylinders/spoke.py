@@ -429,7 +429,13 @@ class EFXhatInnerBound(InnerBoundSpoke):
                            tree=self.options.get("tree"))
         self.efp = efp
         self.n_windows = int(self.options.get("n_windows", 20))
-        self.feas_tol = float(self.options.get("feas_tol", 1e-4))
+        # rp gates how far the first-order compensation can be trusted,
+        # not validity (the published value already carries +|y|'viol);
+        # 1e-3 matches the batched per-scenario evaluators' gate — the
+        # REAL tightness gate is comp_tol (measured under SepRho-driven
+        # candidates: rp plateaued at 8e-4 with comp at 0.15% of the
+        # objective, and a 1e-4 rp gate starved the wheel of any inner)
+        self.feas_tol = float(self.options.get("feas_tol", 1e-3))
         self.comp_tol = float(self.options.get("comp_tol", 2e-3))
         # adopt a fresh candidate after this many syncs without a
         # publication — a root for which the root-fixed EF is

@@ -85,7 +85,18 @@ class SepRho(Extension):
         xr = x_non[real]
         spread = xr.max(axis=0) - xr.min(axis=0)
         cost = _orig_cost_per_slot(batch)
-        _set_rho(ph, self.multiplier * cost / (spread + 1.0))
+        rho = self.multiplier * cost / (spread + 1.0)
+        # Zero-cost nonants (e.g. hydro's reservoir volumes: pure state
+        # variables) would get rho = 0 and never reach consensus — PH's
+        # W update is rho-scaled, so a zero stays zero forever and x̄
+        # wanders on those slots (measured: hydro's inner bound never
+        # published).  Floor them at a tenth of the mean positive rho.
+        pos = rho[rho > 0.0]
+        if pos.size:
+            rho = np.maximum(rho, 0.1 * float(pos.mean()))
+        else:
+            rho = np.full_like(rho, self.multiplier)
+        _set_rho(ph, rho)
 
 
 class CoeffRho(Extension):
