@@ -91,8 +91,12 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     # incumbents inflate it — round 3 reached the published optima at
     # this budget); the OUTER side's bound quality scales with the
     # per-scenario B&B budget on the strengthened model.
-    eval_opts = bnb.BnBOptions(max_rounds=400)
-    lag_opts = bnb.BnBOptions(max_rounds=240)
+    # pump_rounds=0: the feasibility pump's rapid small-dispatch host
+    # loop reliably wedges/crashes the axon TPU worker on these
+    # instances (observed repeatedly, round 5); the multistart + LNS
+    # polish provides the incumbent quality instead
+    eval_opts = bnb.BnBOptions(max_rounds=400, pump_rounds=0)
+    lag_opts = bnb.BnBOptions(max_rounds=240, pump_rounds=0)
 
     # -- 4. candidate pool + batched MIP evaluation ------------------------
     x_non = batch.nonants(drv.state.solver.x)
