@@ -126,13 +126,29 @@ def build_ef(specs: list[ScenarioSpec],
         bl[r] = bu[r] = 0.0
         r += 1
 
+    # SOC metadata rides through assembly: per-scenario blocks shift by
+    # their block-diagonal row offset (link rows stay box rows), so the
+    # EF solve runs the same conic kernel as the decomposed batch
+    cones = None
+    if any(sp.soc_blocks for sp in specs):
+        from mpisppy_tpu.ops import cones as cones_mod
+        all_blocks = []
+        off = 0
+        for sp in specs:
+            for blk in (sp.soc_blocks or []):
+                all_blocks.append(np.asarray(blk, np.int64) + off)
+            off += sp.A.shape[0]
+        cones = cones_mod.cone_spec(m, all_blocks)
+        cones_mod.validate_against_bounds(cones, bl, bu)
     if sparse:
         qp = boxqp.BoxQP(
             c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype), A=A,
             bl=jnp.asarray(bl, dtype), bu=jnp.asarray(bu, dtype),
-            l=jnp.asarray(l, dtype), u=jnp.asarray(u, dtype))
+            l=jnp.asarray(l, dtype), u=jnp.asarray(u, dtype),
+            cones=cones)
     else:
-        qp = boxqp.make_boxqp(c, A, bl, bu, l, u, q=q, dtype=dtype)
+        qp = boxqp.make_boxqp(c, A, bl, bu, l, u, q=q, dtype=dtype,
+                              cones=cones)
     if scale:
         qp, scaling = boxqp.ruiz_scale(qp)
     else:

@@ -193,13 +193,19 @@ def _gather_qp(qp, idx, S: int):
     """Scenario-gather a BoxQP by FIELD LAYOUT, not dim-size guessing:
     a shared dense A is (m, n), and a model with m == S would trip a
     naive shape[0]-equals-S test into gathering the matrix by scenario
-    index (wrong contraction downstream)."""
+    index (wrong contraction downstream).  The same rule holds inside
+    an EllMatrix: only a batched vals (S, m, k) is gathered — cols is
+    a shared (m, k) index array whose leading dim is m, never a
+    scenario axis (a tree_map over S-sized leading dims would silently
+    corrupt it whenever m == S)."""
     def vec(a):       # c/q/l/u: (S, n) batched or (n,) shared
         return a[idx] if a.ndim == 2 else a
 
     A = qp.A
-    if hasattr(A, "matvec"):      # EllMatrix: leaves are (S, ...) or shared
-        A = _gather_scen(A, idx, S)
+    if hasattr(A, "vals"):        # EllMatrix: gather by field layout
+        if A.vals.ndim == 3:      # batched vals (S, m, k)
+            A = dataclasses.replace(A, vals=A.vals[idx])
+        # shared vals (m, k): keep; cols is NEVER scenario-indexed
     elif A.ndim == 3:             # per-scenario dense (S, m, n)
         A = A[idx]
     # else shared dense (m, n): keep
@@ -271,12 +277,19 @@ def _eval_step(batch: ScenarioBatch, cand: Array,
 
     The published value is COMPENSATED for residual infeasibility: an
     rp-infeasible x can undershoot the true recourse optimum by up to
-    ~|y*|'viol (first order), so E[sum_i |y_i| viol_i] is added before
-    publication.  The reference never needs this (Gurobi returns exactly
-    feasible candidates, ref:mpisppy/spopt.py:884); a truncated
-    first-order solve does, or lean warm budgets can publish inner
-    bounds below the optimum (observed on farmer: 8e-4 relative leak).
-    Exactly feasible solves pay zero."""
+    ~|y*|'viol (first order), so COMP_SAFETY * E[sum_i |y_i| viol_i] is
+    added before publication.  The reference never needs this (Gurobi
+    returns exactly feasible candidates, ref:mpisppy/spopt.py:884); a
+    truncated first-order solve does, or lean warm budgets can publish
+    inner bounds below the optimum (observed on farmer: 8e-4 relative
+    leak).  Exactly feasible solves pay zero.  Because the compensation
+    reads the CURRENT truncated-solve dual iterate rather than a
+    verified dual bound, the exact-penalty inequality holds only to
+    first order — the safety factor (xhat.COMP_SAFETY) covers the
+    inexact-dual slack, and the published inner bounds are
+    APPROXIMATELY certified with error O(rp * |y - y*|); the
+    comp-tightness gate below keeps that error a vanishing fraction of
+    the value."""
     qp = batch.with_fixed_nonants(cand)
     st = dataclasses.replace(solver, x=jnp.clip(solver.x, qp.l, qp.u))
     # detect_infeas: a candidate that leaves ANY scenario without
@@ -294,7 +307,7 @@ def _eval_step(batch: ScenarioBatch, cand: Array,
         st = _tail_rescue(qp, st, rp0, real, wopts, wopts.xhat_feas_tol)
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
     viol = boxqp.primal_residual(qp, st.x)
-    comp = jnp.sum(jnp.abs(st.y) * viol, axis=-1)
+    comp = xhat_mod.COMP_SAFETY * jnp.sum(jnp.abs(st.y) * viol, axis=-1)
     obj = obj + comp
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     bad_status = (st.status == pdhg.INFEASIBLE) \
@@ -370,6 +383,15 @@ def _pack_scalars(st: "FusedWheelState") -> Array:
 SCALAR_KEYS = ("conv", "lag_bound", "lag_certified", "xhat_value",
                "xhat_feasible", "xhat_dead", "slam_value",
                "slam_feasible", "shuf_value", "shuf_feasible")
+
+# How many exchanges the pipelined scalar cache lags the dispatched
+# iterate (FusedPH._cache_scalars reads the PREVIOUS iteration's packed
+# scalars, which themselves describe the step before it).  Every host
+# decision that attributes cached flags to a candidate must wait this
+# many evaluations — _iterk_split's flags_fresh references this
+# constant so a pipelining change cannot silently misattribute
+# landed/dead flags (double rotation, skipped rounding tiers).
+SCALAR_PIPELINE_DEPTH = 2
 
 
 @partial(jax.jit, static_argnames=("opts", "wopts"))
@@ -552,7 +574,8 @@ class FusedPH(ph_mod.PH):
         """ONE device->host transfer per iteration: everything the hub
         and the fused spokes decide on.  Pipelined mode reads the
         PREVIOUS iteration's packed scalars right after dispatching the
-        next step, so the host never blocks on the in-flight program —
+        next step (total read lag: SCALAR_PIPELINE_DEPTH exchanges), so
+        the host never blocks on the in-flight program —
         the hub's decisions lag one iteration (bounds are valid at every
         iterate, so a one-iteration-late termination is still certified;
         this is exactly the reference's stale-window tolerance,
@@ -646,13 +669,15 @@ class FusedPH(ph_mod.PH):
                     out, lag_solver=ls, lag_bound=lb, lag_certified=lc)
             if b["xhat"].windows() > 0:
                 sc = self.scalar_cache or {}
-                # the pipelined scalar cache lags TWO iterations (see
-                # _cache_scalars), so right after an adoption the
-                # landed/dead flags still describe the PREVIOUS
-                # candidate — acting on them would rotate twice and
-                # skip a rounding tier; trust them only once this
-                # candidate has been evaluated pipeline-depth exchanges
-                flags_fresh = self._xhat_frozen_for >= 2
+                # the pipelined scalar cache lags SCALAR_PIPELINE_DEPTH
+                # iterations (see _cache_scalars), so right after an
+                # adoption the landed/dead flags still describe the
+                # PREVIOUS candidate — acting on them would rotate
+                # twice and skip a rounding tier; trust them only once
+                # this candidate has been evaluated pipeline-depth
+                # exchanges
+                flags_fresh = (self._xhat_frozen_for
+                               >= SCALAR_PIPELINE_DEPTH)
                 landed = flags_fresh and bool(sc.get("xhat_feasible", 0.0))
                 dead = flags_fresh and bool(sc.get("xhat_dead", 0.0))
                 give_up = self._xhat_frozen_for >= wopts.xhat_give_up

@@ -83,6 +83,15 @@ def lagrangian_mip_bound(batch: ScenarioBatch, W: Array,
     }
 
 
+def _polish_swap(opts: BnBOptions) -> BnBOptions:
+    """Resolve swap_rounds for a polish context: 0 (auto) promotes to
+    bnb.POLISH_SWAP_ROUNDS; an explicit caller value — positive (tuned
+    budget) or negative (force off) — is honored verbatim."""
+    if opts.swap_rounds != 0:
+        return opts
+    return dataclasses.replace(opts, swap_rounds=bnb.POLISH_SWAP_ROUNDS)
+
+
 def evaluate_mip(batch: ScenarioBatch, xhat: Array,
                  opts: BnBOptions = BnBOptions()) -> dict:
     """Certified MIP inner bound: E[f(xhat)] with INTEGER recourse.
@@ -91,7 +100,16 @@ def evaluate_mip(batch: ScenarioBatch, xhat: Array,
     first; each scenario's recourse MIP is then solved by the batched
     B&B.  `value` is +inf unless every real scenario found an
     integer-feasible recourse (matching the reference's all-feasible
-    gate, ref:mpisppy/utils/xhat_eval.py:254-340)."""
+    gate, ref:mpisppy/utils/xhat_eval.py:254-340).
+
+    Candidate evaluation is a POLISH context (the value becomes a
+    published certified inner bound), so the dual-guided SOS1 swap
+    repair is enabled here explicitly (bnb.POLISH_SWAP_ROUNDS) — the
+    base options default it to 0 = auto to keep the hot Lagrangian-
+    oracle loops (lagrangian_mip_bound, mip_dual_bundle) lean; an
+    explicit caller value (positive or negative) is honored verbatim
+    (see BnBOptions.swap_rounds)."""
+    opts = _polish_swap(opts)
     xhat = jnp.asarray(xhat)
     xhat = jnp.where(batch.integer_slot, jnp.round(xhat), xhat)
     qp = batch.with_fixed_nonants(xhat)
@@ -123,7 +141,16 @@ def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
     sslp_15_45_5 at the published-optimal first stage: plain B&B
     incumbents E=-257.6, +swap/LNS -259.4, diversified-LNS merge
     reaches the per-scenario optima on 4 of 5 scenarios (scipy-MILP
-    ground truth -262.4)."""
+    ground truth -262.4).
+
+    The swap repair rides the internal evaluate_mip (a polish context,
+    see its docstring); multistart/LNS are this function's own adds."""
+    # polish context: the dual-guided SOS1 swap repair is enabled
+    # explicitly (bnb.POLISH_SWAP_ROUNDS) for this function's own bnb
+    # calls too (dive_multistart/lns_repair), not just the internal
+    # evaluate_mip — callers passing `base` would otherwise polish with
+    # the lean swap_rounds=0 defaults
+    opts = _polish_swap(opts)
     # callers holding a fresh evaluate_mip dict for the SAME xhat can
     # pass it as `base` and skip the (expensive) internal re-solve
     if base is None:
@@ -167,7 +194,13 @@ def evaluate_mip_many(batch: ScenarioBatch, xhats,
     batched B&B of K*S subproblems (the TPU answer to the reference's
     shuffle looper trying candidates sequentially across ranks,
     ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:23-157).
-    Returns one evaluate_mip-style dict per candidate."""
+    Returns one evaluate_mip-style dict per candidate.
+
+    Like its siblings this is a POLISH context (the values become
+    published certified inner bounds), so swap_rounds=0 (auto)
+    promotes to bnb.POLISH_SWAP_ROUNDS — pass a negative swap_rounds
+    to force the repair off for cheap candidate screening."""
+    opts = _polish_swap(opts)
     K = len(xhats)
     if K == 0:
         return []
@@ -437,7 +470,12 @@ def ef_mip(ef_problem, specs, opts: BnBOptions = BnBOptions(),
     """Exact MIP solve of an assembled extensive form (algos/ef.py
     EFProblem) — the correctness oracle for the decomposition bounds
     (ref:mpisppy/opt/ef.py:75-104's role).  Returns inner/outer/gap and
-    the (S, n) per-scenario solution in original space."""
+    the (S, n) per-scenario solution in original space.
+
+    A one-shot oracle is a POLISH context (not a hot Lagrangian loop),
+    so swap_rounds=0 (auto) promotes to bnb.POLISH_SWAP_ROUNDS here
+    like the other final-candidate entry points."""
+    opts = _polish_swap(opts)
     qp = ef_problem.qp
     n_tot = qp.c.shape[-1]
     n = ef_problem.n_per_scen

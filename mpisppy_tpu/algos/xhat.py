@@ -23,17 +23,35 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mpisppy_tpu.core.batch import ScenarioBatch
 from mpisppy_tpu.ops import boxqp, pdhg
 
 Array = jax.Array
 
+# Safety factor on the first-order infeasibility compensation
+# E[sum |y| viol]: the compensation uses the current (truncated-solve)
+# dual iterate, not a verified dual bound, so the exact-penalty
+# inequality f* <= f(x) + ||y*||'viol need not hold exactly — the
+# published inner bounds are APPROXIMATELY certified, with error
+# O(rp * |y - y*|).  Doubling the measured compensation covers the
+# inexact-dual slack at first order; the comp-tightness gate
+# (comp_tight / fused_wheel._eval_step) bounds how much of the value
+# the (scaled) compensation may be, so the slack stays a vanishing
+# fraction of the bound.  Exactly feasible solves pay zero either way.
+COMP_SAFETY = 2.0
+
+# Max expected compensation relative to the value a published inner
+# bound may carry (the gate every publication path enforces — matches
+# fused_wheel.FusedWheelOptions.xhat_comp_tol and EFXhatInnerBound).
+DEFAULT_COMP_TOL = 2e-3
+
 
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["value", "per_scenario", "feasible", "primal_resid",
-                 "status"],
+                 "status", "comp"],
     meta_fields=[],
 )
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +61,32 @@ class XhatResult:
     feasible: Array      # () bool — every real scenario feasible at tol
     primal_resid: Array  # (S,) relative primal residuals
     status: Array        # (S,) int32 pdhg status (INFEASIBLE certified)
+    comp: Array          # (S,) safety-scaled first-order infeasibility
+    #                      compensation already INCLUDED in per_scenario
+
+
+def comp_tight_mask(values, ecomps,
+                    comp_tol: float = DEFAULT_COMP_TOL) -> np.ndarray:
+    """Vectorized publication tightness gate — THE single host-side
+    source of the formula (comp_tight and the batched shuffle harvest
+    both call it; fused_wheel._eval_step is the in-graph twin): finite
+    value AND E[comp] <= comp_tol * max(1, |value|)."""
+    values = np.asarray(values, np.float64)
+    ecomps = np.asarray(ecomps, np.float64)
+    return np.isfinite(values) \
+        & (ecomps <= comp_tol * np.maximum(1.0, np.abs(values)))
+
+
+def comp_tight(batch: ScenarioBatch, res: XhatResult,
+               comp_tol: float = DEFAULT_COMP_TOL) -> bool:
+    """Publication tightness gate (host-side): the compensation is
+    first-order, so a value whose compensation is a material fraction
+    of the bound itself is not trustworthy (hydro measured +37% at
+    stiff duals).  Matches fused_wheel._eval_step's in-loop gate —
+    callers check this before offering res.value as an incumbent."""
+    return bool(comp_tight_mask(float(res.value),
+                                float(batch.expectation(res.comp)),
+                                comp_tol))
 
 
 def evaluate_warm(batch: ScenarioBatch, xhat: Array,
@@ -78,8 +122,9 @@ def _evaluate_warm_core(batch: ScenarioBatch, xhat: Array,
     st = pdhg.solve(qp, opts, st)
     # first-order infeasibility compensation — see _evaluate_core
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
-    obj = obj + jnp.sum(jnp.abs(st.y) * boxqp.primal_residual(qp, st.x),
-                        axis=-1)
+    comp = COMP_SAFETY * jnp.sum(
+        jnp.abs(st.y) * boxqp.primal_residual(qp, st.x), axis=-1)
+    obj = obj + comp
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     real = batch.p > 0.0
     scen_ok = (rp <= feas_tol) & (st.status != pdhg.INFEASIBLE) \
@@ -88,7 +133,7 @@ def _evaluate_warm_core(batch: ScenarioBatch, xhat: Array,
     value = jnp.where(feas, batch.expectation(obj),
                       jnp.asarray(jnp.inf, obj.dtype))
     return XhatResult(value=value, per_scenario=obj, feasible=feas,
-                      primal_resid=rp, status=st.status), st
+                      primal_resid=rp, status=st.status, comp=comp), st
 
 
 def evaluate(batch: ScenarioBatch, xhat: Array,
@@ -124,6 +169,7 @@ def _rescue_merge(batch: ScenarioBatch, xhat: Array, res: XhatResult,
         return res
     ok = _scen_ok(res, feas_tol)
     per, rp, status = res.per_scenario, res.primal_resid, res.status
+    comp = res.comp
     real = batch.p > 0.0
     # re-solving only helps UNCONVERGED scenarios; a certified
     # Farkas/recession status cannot improve, so skip the (expensive)
@@ -148,6 +194,7 @@ def _rescue_merge(batch: ScenarioBatch, xhat: Array, res: XhatResult,
         per = jnp.where(newly, r2.per_scenario, per)
         rp = jnp.where(newly, r2.primal_resid, rp)
         status = jnp.where(newly, r2.status, status)
+        comp = jnp.where(newly, r2.comp, comp)
         ok = ok | ok2
         if bool(jnp.all(jnp.where(real, ok, True))):
             break
@@ -155,7 +202,7 @@ def _rescue_merge(batch: ScenarioBatch, xhat: Array, res: XhatResult,
     value = jnp.where(feas, batch.expectation(per),
                       jnp.asarray(jnp.inf, per.dtype))
     return XhatResult(value=value, per_scenario=per, feasible=feas,
-                      primal_resid=rp, status=status)
+                      primal_resid=rp, status=status, comp=comp)
 
 
 @partial(jax.jit, static_argnames=("opts", "feas_tol"))
@@ -176,14 +223,18 @@ def _evaluate_core(batch: ScenarioBatch, xhat: Array,
     opts = dataclasses.replace(opts, detect_infeas=True)
     st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
     # Original-space objective: scaled c,q absorb the column scaling.
-    # First-order infeasibility compensation (+E[sum |y| viol]): an
-    # rp-tolerant "feasible" x can undershoot the true recourse optimum
-    # by ~|y*|'viol, so the published inner value is pushed up by that
-    # margin — zero at exact feasibility (same rule as the fused
-    # planes, algos/fused_wheel._eval_step).
+    # First-order infeasibility compensation (+COMP_SAFETY * E[sum |y|
+    # viol]): an rp-tolerant "feasible" x can undershoot the true
+    # recourse optimum by ~|y*|'viol, so the published inner value is
+    # pushed up by that (safety-scaled) margin — zero at exact
+    # feasibility (same rule as the fused planes,
+    # algos/fused_wheel._eval_step).  The result is APPROXIMATELY
+    # certified (see COMP_SAFETY); callers gate publication on
+    # comp_tight.
     obj = jnp.sum(qp.c * st.x + 0.5 * qp.q * st.x * st.x, axis=-1)
-    obj = obj + jnp.sum(jnp.abs(st.y) * boxqp.primal_residual(qp, st.x),
-                        axis=-1)
+    comp = COMP_SAFETY * jnp.sum(
+        jnp.abs(st.y) * boxqp.primal_residual(qp, st.x), axis=-1)
+    obj = obj + comp
     rp, _, _ = boxqp.kkt_residuals(qp, st.x, st.y)
     real = batch.p > 0.0
     # UNBOUNDED is excluded too: a frozen partially-converged iterate of
@@ -195,7 +246,7 @@ def _evaluate_core(batch: ScenarioBatch, xhat: Array,
     value = jnp.where(feas, batch.expectation(obj),
                       jnp.asarray(jnp.inf, obj.dtype))
     return XhatResult(value=value, per_scenario=obj, feasible=feas,
-                      primal_resid=rp, status=st.status)
+                      primal_resid=rp, status=st.status, comp=comp)
 
 
 def round_integers(batch: ScenarioBatch, xhat: Array,
@@ -241,20 +292,21 @@ def xhat_shuffle(batch: ScenarioBatch, x_non: Array, scen_ids: Array,
     x_non: (S, N) current per-scenario nonants; scen_ids: (k,) candidate
     indices (host supplies the deterministic shuffle, seed 42, matching
     ref:mpisppy/cylinders/xhatshufflelooper_bounder.py:61-99).  Returns
-    (values (k,), feasible (k,), cands (k, N)) — the host picks the
-    best; cands is the (rounded) candidate tensor actually evaluated, so
-    callers never recompute it.  The reference tries candidates one at a
-    time across ranks; here the K trials batch into one
-    (k*S)-subproblem program.
+    (values (k,), feasible (k,), cands (k, N), comps (k,)) — the host
+    picks the best; cands is the (rounded) candidate tensor actually
+    evaluated, so callers never recompute it; comps is each value's
+    expected first-order compensation for the comp_tight gate.  The
+    reference tries candidates one at a time across ranks; here the K
+    trials batch into one (k*S)-subproblem program.
     """
     cands = round_integers(batch, x_non[scen_ids])  # (k, N)
 
     def one(xhat):
         r = _evaluate_core(batch, xhat, opts)
-        return r.value, r.feasible
+        return r.value, r.feasible, batch.expectation(r.comp)
 
-    values, feas = jax.vmap(one)(cands)
-    return values, feas, cands
+    values, feas, comps = jax.vmap(one)(cands)
+    return values, feas, cands, comps
 
 
 def slam_candidate(batch: ScenarioBatch, x_non: Array,

@@ -61,6 +61,12 @@ class ScenarioSpec:
     # (ref:mpisppy/spbase.py:398-441): weight 0 marks a slot absent from
     # this scenario (admm wrappers); None -> ordinary probabilities.
     var_prob: np.ndarray | None = None  # (N,) weights
+    # second-order-cone row blocks: a list of int row-index arrays,
+    # HEAD FIRST (rows (t; z) with a_head'x - b >= ||(Ax - b)_tail||);
+    # SOC rows must carry bl == bu == b.  The cone PATTERN must be
+    # identical across the batch (like the nonant layout).  None -> a
+    # pure box problem (ops/cones.py documents the full contract).
+    soc_blocks: list | None = None
 
 
 @partial(
@@ -275,11 +281,28 @@ def from_specs(specs: list[ScenarioSpec],
     q = np.stack([np.zeros(n) if sp.q is None else np.asarray(sp.q, np.float64)
                   for sp in specs])
     A = stack_A()
+    cones = None
+    if any(sp.soc_blocks for sp in specs):
+        from mpisppy_tpu.ops import cones as cones_mod
+        blocks0 = [np.asarray(b, np.int64)
+                   for b in (specs[0].soc_blocks or [])]
+        for sp in specs[1:]:
+            other = sp.soc_blocks or []
+            if len(other) != len(blocks0) or not all(
+                    np.array_equal(np.asarray(b, np.int64), b0)
+                    for b, b0 in zip(other, blocks0)):
+                raise ValueError(
+                    f"scenario {sp.name}: SOC block pattern differs from "
+                    "scenario 0's (the cone partition is shared across "
+                    "the batch, like the nonant layout)")
+        cones = cones_mod.cone_spec(specs[0].A.shape[0], blocks0)
+        cones_mod.validate_against_bounds(cones, stack("bl"), stack("bu"))
     qp = BoxQP(
         c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype),
         A=A if not isinstance(A, np.ndarray) else jnp.asarray(A, dtype),
         bl=jnp.asarray(stack("bl"), dtype), bu=jnp.asarray(stack("bu"), dtype),
         l=jnp.asarray(stack("l"), dtype), u=jnp.asarray(stack("u"), dtype),
+        cones=cones,
     )
     if scale:
         qp, scaling = ruiz_scale(qp)

@@ -93,7 +93,14 @@ class OuterBoundSpoke(Spoke):
 class InnerBoundSpoke(Spoke):
     """Incumbent finders; keeps the best (xhat, value) pair so the
     winning solution can be written out (ref:mpisppy/cylinders/
-    spoke.py:242-248,325-367 update_if_improving + best cache)."""
+    spoke.py:242-248,325-367 update_if_improving + best cache).
+
+    Publication is gated on BOTH feasibility and comp-tightness
+    (xhat.comp_tight): the evaluators' first-order infeasibility
+    compensation makes values only approximately certified, so a value
+    whose compensation is a material fraction of the bound stays
+    unpublished — the same gate the fused planes (_eval_step) and
+    EFXhatInnerBound enforce."""
 
     converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,)
     bound_sense = "inner"
@@ -101,6 +108,8 @@ class InnerBoundSpoke(Spoke):
     def __init__(self, opt, options=None):
         super().__init__(opt, options)
         self.best_xhat = None  # (num_nodes, N) or (N,) candidate
+        self.comp_tol = float(self.options.get(
+            "comp_tol", xhat_mod.DEFAULT_COMP_TOL))
 
     def _offer(self, value: float, xhat) -> None:
         if self.bound is None or value < self.bound:
@@ -117,7 +126,8 @@ class InnerBoundSpoke(Spoke):
             return None
         res, xhat = self._pending
         res = self._finalize(res, xhat)
-        if bool(res.feasible):
+        if bool(res.feasible) and xhat_mod.comp_tight(self.batch, res,
+                                                      self.comp_tol):
             self._offer(float(res.value), xhat)
         return self.bound
 
@@ -209,7 +219,8 @@ class FusedXhatXbarInnerBound(InnerBoundSpoke):
                 self.opt.wstate = _dc.replace(wstate, xhat_solver=st)
             else:
                 res = xhat_mod.evaluate(self.batch, cand, self.pdhg_opts)
-            if bool(res.feasible):
+            if bool(res.feasible) and xhat_mod.comp_tight(
+                    self.batch, res, self.comp_tol):
                 self._offer(float(res.value), np.asarray(cand))
         return self.bound
 
@@ -389,7 +400,10 @@ def _ef_root_fixed_solve(qp, cols, xs, st, windows, opts):
     st = pdhg.solve_fixed(qp2, windows, opts, st)
     obj = jnp.sum(qp2.c * st.x + 0.5 * qp2.q * st.x * st.x)
     viol = boxqp.primal_residual(qp2, st.x)
-    comp = jnp.sum(jnp.abs(st.y) * viol)
+    # safety-scaled first-order compensation (xhat.COMP_SAFETY): the
+    # dual iterate is truncated, so the published obj + comp is
+    # APPROXIMATELY certified, error O(rp * |y - y*|)
+    comp = xhat_mod.COMP_SAFETY * jnp.sum(jnp.abs(st.y) * viol)
     rp, _, _ = boxqp.kkt_residuals(qp2, st.x, st.y)
     dead = (st.status == pdhg.INFEASIBLE) | (st.status == pdhg.UNBOUNDED)
     return st, obj, comp, rp, dead
@@ -408,10 +422,11 @@ class EFXhatInnerBound(InnerBoundSpoke):
     fixed — measured recourse duals ~1e6 and a +37% first-order
     compensation; no valid tight bound exists at such points).
 
-    Publication: obj + |y|'viol (first-order infeasibility
-    compensation, EF duals are bounded here) once the primal residual
-    clears feas_tol AND the compensation is below comp_tol*|obj| — so
-    published values are valid AND tight.  The candidate root stays
+    Publication: obj + COMP_SAFETY*|y|'viol (safety-scaled first-order
+    infeasibility compensation, EF duals are bounded here) once the
+    primal residual clears feas_tol AND the compensation is below
+    comp_tol*|obj| — published values are APPROXIMATELY certified
+    (error O(rp * |y - y*|), see xhat.COMP_SAFETY) and tight.  The candidate root stays
     FROZEN across syncs until it publishes, letting the warm EF solve
     accumulate.  Use for multistage batches; two-stage recourse is
     better served by the batched XhatXbar/Fused planes."""
@@ -616,9 +631,11 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
     def harvest(self):
         if self._pending is None:
             return None
-        vals, feas, cands = self._pending
+        vals, feas, cands, comps = self._pending
         vals = np.asarray(vals)
         feas = np.asarray(feas)
+        # comp-tightness gate, batched (see InnerBoundSpoke.harvest)
+        feas = feas & xhat_mod.comp_tight_mask(vals, comps, self.comp_tol)
         if feas.any():
             j = int(np.argmin(np.where(feas, vals, np.inf)))
             self._offer(float(vals[j]), np.asarray(cands)[j])
@@ -633,7 +650,8 @@ class XhatShuffleInnerBound(InnerBoundSpoke):
                 res = xhat_mod.evaluate(self.batch,
                                         jnp.asarray(np.asarray(cands)[j]),
                                         self.pdhg_opts)
-                if bool(res.feasible):
+                if bool(res.feasible) and xhat_mod.comp_tight(
+                        self.batch, res, self.comp_tol):
                     self._offer(float(res.value), np.asarray(cands)[j])
                     break
         return self.bound

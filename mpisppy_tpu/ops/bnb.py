@@ -51,6 +51,12 @@ from mpisppy_tpu.ops.boxqp import BoxQP
 
 Array = jax.Array
 
+# swap_rounds the POLISH entry points (mip.evaluate_mip_polished,
+# final-candidate certification like sslp_cert) enable explicitly —
+# the round-5-measured budget that was briefly the global default
+# before the hot Lagrangian-oracle cost moved it here.
+POLISH_SWAP_ROUNDS = 24
+
 
 @dataclasses.dataclass(frozen=True)
 class BnBOptions:
@@ -95,7 +101,16 @@ class BnBOptions:
     # This closes the assignment-quality gap dive/B&B incumbents leave
     # on SOS1-structured recourse (sslp_15_45_5 at the optimal first
     # stage: -255.8 -> toward the true -262.4, measured round 5).
-    swap_rounds: int = 24
+    # Default 0 = AUTO: on SOS1-structured models the repair costs up
+    # to ~2*swap_rounds warm node re-solves per solve_mip call, which
+    # the hot Lagrangian-oracle loops (mip.lagrangian_mip_bound /
+    # mip_dual_bundle) pay every step for a polish aimed at final
+    # candidates — so auto means OFF everywhere except the polish
+    # entry points (mip.evaluate_mip / evaluate_mip_polished), which
+    # promote it to POLISH_SWAP_ROUNDS.  An explicit POSITIVE value is
+    # honored verbatim everywhere; a NEGATIVE value forces the repair
+    # off even in polish contexts (sos1_swap_repair no-ops on <= 0).
+    swap_rounds: int = 0
     # deterministic relative objective jitter for the NODE SOLVES ONLY:
     # breaks degenerate ties so the kernel's face-point iterates move
     # toward a unique vertex.  Bounds and objectives are always

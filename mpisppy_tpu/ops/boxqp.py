@@ -69,7 +69,7 @@ def set_matvec_precision(p) -> None:
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["c", "q", "A", "bl", "bu", "l", "u"],
+    data_fields=["c", "q", "A", "bl", "bu", "l", "u", "cones"],
     meta_fields=[],
 )
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +80,13 @@ class BoxQP:
     A batch of S scenarios adds a leading S axis to every field, or — for
     scenario families whose constraint matrix is deterministic (e.g. sslp,
     where only the RHS is random) — `A` may stay (m,n) and broadcast.
+
+    cones: optional ops.cones.ConeSpec partitioning the rows into box
+    rows and second-order-cone blocks (shared across the batch — the
+    cone PATTERN is deterministic like the ELL sparsity pattern).  SOC
+    block rows store their shift b in BOTH bl and bu; see ops/cones.py
+    for the full contract.  None (the default) is the pure box problem
+    and keeps every hot path on the specialized clip kernels.
     """
 
     c: Array
@@ -89,6 +96,7 @@ class BoxQP:
     bu: Array
     l: Array  # noqa: E741
     u: Array
+    cones: "object | None" = None
 
     @property
     def n(self) -> int:
@@ -142,11 +150,15 @@ class BoxQP:
         return jnp.einsum("mn,...m->...n", self.A, y, precision=prec)
 
 
-def make_boxqp(c, A, bl, bu, l, u, q=None, dtype=jnp.float32) -> BoxQP:  # noqa: E741
+def make_boxqp(c, A, bl, bu, l, u, q=None, dtype=jnp.float32,  # noqa: E741
+               cones=None) -> BoxQP:
     """Build a BoxQP from numpy-ish inputs, defaulting q to zeros."""
     c = jnp.asarray(c, dtype)
     if q is None:
         q = jnp.zeros_like(c)
+    if cones is not None:
+        from mpisppy_tpu.ops import cones as cones_mod
+        cones_mod.validate_against_bounds(cones, bl, bu)
     return BoxQP(
         c=c,
         q=jnp.asarray(q, dtype),
@@ -155,6 +167,7 @@ def make_boxqp(c, A, bl, bu, l, u, q=None, dtype=jnp.float32) -> BoxQP:  # noqa:
         bu=jnp.asarray(bu, dtype),
         l=jnp.asarray(l, dtype),
         u=jnp.asarray(u, dtype),
+        cones=cones,
     )
 
 
@@ -174,7 +187,12 @@ def dual_objective(p: BoxQP, x: Array, y: Array) -> Array:
     """
     rc = p.c + p.q * x + p.rmatvec(y)
     # -g*(y): y>0 pairs with bu, y<0 with bl (our sign convention:
-    # y in dsubgradient of I_[bl,bu] at Ax).
+    # y in dsubgradient of I_[bl,bu] at Ax).  SOC rows need NO special
+    # case: they store their shift b in both bl and bu, so this
+    # collapses to b*y — exactly -g*(y) for y in the polar cone, which
+    # every PDHG iterate satisfies by construction (cones.dual_prox);
+    # any distance to the polar cone is charged to the dual residual
+    # (kkt_residuals), PDLP-style.
     ycontrib = jnp.where(y > 0.0, p.bu * y, p.bl * y)
     ycontrib = jnp.where(jnp.isfinite(ycontrib), ycontrib, 0.0)
     # reduced-cost bound contribution: rc>0 pairs with l, rc<0 with u.
@@ -205,6 +223,14 @@ def certified_dual_bound(p: BoxQP, x: Array, y: Array) -> Array:
         f(z) >= -1/2 x'Qx - g*(y) + inf_{l<=z<=u} (c + Qx + A'y)'z ,
     valid for every feasible z by convexity + weak duality.
     """
+    if p.cones is not None:
+        # SOC blocks: g*(y) = b'y requires y in the polar cone -K;
+        # projecting there first is free (any y in the dual domain
+        # yields a valid bound) and the bl==bu==b storage then makes
+        # the box accounting below exact for these rows (box rows pass
+        # through project_polar_rows unchanged).
+        from mpisppy_tpu.ops import cones as cones_mod
+        y = cones_mod.project_polar_rows(p.cones, y)
     yp = jnp.where(jnp.isfinite(p.bu), y, jnp.minimum(y, 0.0))
     yp = jnp.where(jnp.isfinite(p.bl), yp, jnp.maximum(yp, 0.0))
     gstar = jnp.where(yp > 0.0, p.bu * yp, p.bl * yp)
@@ -217,9 +243,17 @@ def certified_dual_bound(p: BoxQP, x: Array, y: Array) -> Array:
 
 
 def primal_residual(p: BoxQP, x: Array) -> Array:
-    """Per-row distance of Ax from [bl, bu] (0 when feasible)."""
+    """Per-row distance of Ax from the row feasible set: [bl, bu] on box
+    rows, the shifted second-order cone b + K on SOC blocks (rowwise
+    |ax - Proj(ax)|, so the inf-norm reductions downstream are
+    uniform).  0 when feasible."""
     ax = p.matvec(x)
-    return jnp.maximum(ax - p.bu, 0.0) + jnp.maximum(p.bl - ax, 0.0)
+    r = jnp.maximum(ax - p.bu, 0.0) + jnp.maximum(p.bl - ax, 0.0)
+    if p.cones is not None:
+        from mpisppy_tpu.ops import cones as cones_mod
+        soc = cones_mod.primal_violation_rows(p.cones, ax, p.bl)
+        r = jnp.where(p.cones.is_soc, soc, r)
+    return r
 
 
 def dual_residual(p: BoxQP, x: Array, y: Array) -> Array:
@@ -237,9 +271,20 @@ def dual_residual(p: BoxQP, x: Array, y: Array) -> Array:
 
 
 def kkt_residuals(p: BoxQP, x: Array, y: Array):
-    """(rel_primal, rel_dual, rel_gap) — relative inf-norm KKT residuals."""
+    """(rel_primal, rel_dual, rel_gap) — relative inf-norm KKT residuals.
+
+    Conic problems fold the conic dual-feasibility residual (distance of
+    each dual SOC block to the polar cone) into rel_dual, so every
+    certificate gate downstream (lagrangian_bound's `certified`, the
+    fused planes' dual-residual check, xhat feasibility) automatically
+    refuses bounds whose conic Fenchel accounting has not converged."""
     rp = jnp.max(jnp.abs(primal_residual(p, x)), axis=-1)
     rd = jnp.max(jnp.abs(dual_residual(p, x, y)), axis=-1)
+    if p.cones is not None:
+        from mpisppy_tpu.ops import cones as cones_mod
+        rd = jnp.maximum(
+            rd, jnp.max(cones_mod.dual_cone_residual_rows(p.cones, y),
+                        axis=-1))
     b_scale = jnp.where(jnp.isfinite(p.bl), jnp.abs(p.bl), 0.0)
     b_scale = jnp.maximum(b_scale, jnp.where(jnp.isfinite(p.bu), jnp.abs(p.bu), 0.0))
     c_scale = jnp.max(jnp.abs(p.c), axis=-1, initial=0.0)
@@ -266,6 +311,15 @@ def infeasibility_certificate(p: BoxQP, y: Array, tol: float = 1e-6) -> Array:
     q to -inf (no certificate).  `y` is normalized here; the test is
     q(y)/||y||_1 > tol.
     """
+    if p.cones is not None:
+        # On SOC blocks sup_{v in b+K} y'v is b'y only for y in the
+        # polar cone (else +inf); treating the bl==bu storage as an
+        # equality row would UNDERSTATE the sup and could fabricate a
+        # Farkas certificate for a feasible conic problem.  Projecting
+        # y onto the polar cone first keeps the test exact (any polar
+        # y is a legitimate Farkas candidate; box rows pass through).
+        from mpisppy_tpu.ops import cones as cones_mod
+        y = cones_mod.project_polar_rows(p.cones, y)
     nrm = jnp.sum(jnp.abs(y), axis=-1, keepdims=True)
     yn = y / jnp.maximum(nrm, 1e-30)
     z = p.rmatvec(yn)
@@ -304,9 +358,17 @@ def unboundedness_certificate(p: BoxQP, d: Array, tol: float = 1e-6) -> Array:
     nrm = jnp.sum(jnp.abs(d), axis=-1, keepdims=True)
     dn = d / jnp.maximum(nrm, 1e-30)
     ad = p.matvec(dn)
-    ok_rows = jnp.all(
-        jnp.where(jnp.isfinite(p.bu), ad <= tol, True)
-        & jnp.where(jnp.isfinite(p.bl), ad >= -tol, True), axis=-1)
+    row_ok = jnp.where(jnp.isfinite(p.bu), ad <= tol, True) \
+        & jnp.where(jnp.isfinite(p.bl), ad >= -tol, True)
+    if p.cones is not None:
+        # recession cone of b + K is K itself: the direction's block
+        # must (approximately) lie in the cone, not vanish (the bl==bu
+        # box test would demand |ad| <= tol — a strict subset of K that
+        # misses genuine conic recession rays)
+        from mpisppy_tpu.ops import cones as cones_mod
+        soc_dist = jnp.abs(ad - cones_mod.project_soc_rows(p.cones, ad))
+        row_ok = jnp.where(p.cones.is_soc, soc_dist <= tol, row_ok)
+    ok_rows = jnp.all(row_ok, axis=-1)
     ok_box = jnp.all(
         jnp.where(jnp.isfinite(p.u), dn <= tol, True)
         & jnp.where(jnp.isfinite(p.l), dn >= -tol, True), axis=-1)
@@ -335,15 +397,39 @@ class Scaling:
     d_col: np.ndarray
 
 
+def group_row_scales(rmax: np.ndarray, cones) -> np.ndarray:
+    """Force row scale factors UNIFORM within each SOC block (the block
+    max): per-row scaling D v of a block breaks ||z|| <= t unless D is
+    a positive multiple of the identity on the block, while a shared
+    scale maps b + K to (d b) + K exactly.  Box rows keep their own
+    scale.  rmax: (..., m) positive row maxima."""
+    if cones is None:
+        return rmax
+    seg = np.asarray(cones.seg)
+    is_soc = np.asarray(cones.is_soc)
+    C = cones.num_cones + 1
+    m = rmax.shape[-1]
+    bshape = rmax.shape[:-1]
+    B = int(np.prod(bshape)) if bshape else 1
+    flat = rmax.reshape(B, m)
+    blk = np.zeros((B, C), flat.dtype)
+    np.maximum.at(blk, (np.repeat(np.arange(B), m), np.tile(seg, B)),
+                  flat.reshape(-1))
+    grouped = np.where(is_soc[None, :], blk[:, seg], flat)
+    return grouped.reshape(rmax.shape)
+
+
 def ruiz_scale(p: BoxQP, iters: int = 10) -> tuple[BoxQP, Scaling]:
     """Iterative row/col inf-norm equilibration of A, applied to the
     whole problem.  Batched A gets per-batch scalings.  Dispatches to
-    the ELL-form loop for sparse A (ops.sparse.ruiz_scale_ell)."""
+    the ELL-form loop for sparse A (ops.sparse.ruiz_scale_ell).  SOC
+    blocks get block-uniform row scales (see group_row_scales)."""
     from mpisppy_tpu.ops import sparse as sparse_mod
     dt = p.c.dtype
     if isinstance(p.A, sparse_mod.EllMatrix):
         vals, dr, dc = sparse_mod.ruiz_scale_ell(
-            np.asarray(p.A.vals), np.asarray(p.A.cols), p.A.n, iters)
+            np.asarray(p.A.vals), np.asarray(p.A.cols), p.A.n, iters,
+            cones=p.cones)
         A_scaled = dataclasses.replace(p.A, vals=jnp.asarray(vals, dt))
     else:
         A = np.asarray(p.A, np.float64)
@@ -356,6 +442,7 @@ def ruiz_scale(p: BoxQP, iters: int = 10) -> tuple[BoxQP, Scaling]:
             # into an inf scaling
             rmax = np.max(np.abs(A), axis=-1)
             rmax = np.where(rmax <= 0.0, 1.0, rmax)
+            rmax = group_row_scales(rmax, p.cones)
             A = A / np.sqrt(rmax)[..., None]
             dr = dr / np.sqrt(rmax)
             cmax = np.max(np.abs(A), axis=-2)
@@ -364,6 +451,7 @@ def ruiz_scale(p: BoxQP, iters: int = 10) -> tuple[BoxQP, Scaling]:
             dc = dc / np.sqrt(cmax)
         A_scaled = jnp.asarray(A, dt)
     scaled = BoxQP(
+        cones=p.cones,
         c=jnp.asarray(np.asarray(p.c, np.float64) * dc, dt),
         q=jnp.asarray(np.asarray(p.q, np.float64) * dc * dc, dt),
         A=A_scaled,

@@ -1,0 +1,228 @@
+###############################################################################
+# Second-order-cone rows for the BoxQP kernel.
+#
+# The README's documented upgrade path (ccopf scope decision): SOC rows
+# are the natural convex relaxation of AC power flow, and supporting
+# them generalizes the subproblem class from box-LP/QP to conic — the
+# same kernel-generalization move MPAX makes for JAX-native mathematical
+# programming (PAPERS.md, arXiv:2412.09734), inheriting restarted-PDHG
+# convergence for conic feasible sets from the PDLP line of work the
+# kernel already follows.
+#
+# Contract (the ConeSpec contract, see docs/cones.md):
+#
+#   * A ConeSpec PARTITIONS the m constraint rows of a BoxQP into box
+#     rows and disjoint SOC blocks.  A block is a set of rows
+#     (head; tail_1..tail_d) whose constraint is
+#
+#         (A x - b)_block  in  K_soc   i.e.
+#         a_head'x - b_head  >=  || (A x - b)_tail ||_2
+#
+#     with the per-row shifts b stored in BOTH bl and bu of the block's
+#     rows (bl == bu == b).  That storage convention is load-bearing:
+#     dual_objective's box accounting where(y>0, bu*y, bl*y) collapses
+#     to b'y on SOC rows — exactly -g*(y) for y in the polar cone — so
+#     the Fenchel machinery needs no special case, and Ruiz row scaling
+#     of bl/bu scales the shift consistently with the block (row scales
+#     are forced UNIFORM within a block; see boxqp.ruiz_scale).
+#   * Blocks are ragged; the per-row segment encoding (`seg`) pads them
+#     onto a shared (num_cones + 1)-segment axis so every blockwise
+#     reduction is ONE fused scatter-add/gather pair over the row axis —
+#     static shapes, batched over scenarios by broadcasting, no masks in
+#     the hot path (box rows land in the sentinel segment, which is
+#     never read back).
+#   * The dual prox of the row indicator becomes, via Moreau and the
+#     positive homogeneity of cone projections (no division by sigma):
+#         box rows:  y1 = w - clip(w, sigma*bl, sigma*bu)
+#         SOC rows:  y1 = Proj_polar(w - sigma*b)
+#     so dual ITERATES always lie in the polar cone -K (SOC is
+#     self-dual) and the conic dual-feasibility residual below is the
+#     certificate that warm starts / window averages have not left it.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_TINY = 1e-30
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["is_soc", "is_head", "seg"],
+    meta_fields=["num_cones", "max_dim", "head_rows"],
+)
+@dataclasses.dataclass(frozen=True)
+class ConeSpec:
+    """Static partition of a BoxQP's m rows into box rows + SOC blocks.
+
+    is_soc:    (m,) bool — row belongs to some SOC block.
+    is_head:   (m,) bool — row is its block's head (the t component).
+    seg:       (m,) int32 — block id for SOC rows; `num_cones` (the
+               sentinel segment) for box rows.
+    num_cones: static block count.
+    max_dim:   static max block dimension (head + tails) — the padding
+               width downstream fixed-shape consumers (the Pallas
+               membership matrices) size against.
+    head_rows: static (num_cones,) tuple — block b's head row index.
+               STATIC (a meta field) so consumers needing per-block
+               row gathers (FBBT's head-activity bound) can slice A
+               at trace time instead of reducing over all m rows.
+    """
+
+    is_soc: Array
+    is_head: Array
+    seg: Array
+    num_cones: int
+    max_dim: int
+    head_rows: tuple = ()
+
+    @property
+    def m(self) -> int:
+        return self.is_soc.shape[0]
+
+
+def cone_spec(m: int, blocks) -> ConeSpec:
+    """Build a ConeSpec from `blocks`: a list of int row-index arrays,
+    HEAD FIRST, each of length >= 2, pairwise disjoint."""
+    is_soc = np.zeros(m, bool)
+    is_head = np.zeros(m, bool)
+    seg = np.full(m, len(blocks), np.int32)
+    max_dim = 0
+    heads = []
+    for b, rows in enumerate(blocks):
+        rows = np.asarray(rows, np.int64)
+        if rows.ndim != 1 or len(rows) < 2:
+            raise ValueError(f"SOC block {b}: need head + >=1 tail rows")
+        if len(np.unique(rows)) != len(rows):
+            # duplicates collapse in the fancy assignments below and
+            # would silently build a LOOSER cone than specified
+            raise ValueError(f"SOC block {b}: duplicate row indices")
+        if is_soc[rows].any():
+            raise ValueError(f"SOC block {b}: overlaps another block")
+        is_soc[rows] = True
+        is_head[rows[0]] = True
+        heads.append(int(rows[0]))
+        seg[rows] = b
+        max_dim = max(max_dim, len(rows))
+    return ConeSpec(
+        is_soc=jnp.asarray(is_soc), is_head=jnp.asarray(is_head),
+        seg=jnp.asarray(seg), num_cones=len(blocks), max_dim=max_dim,
+        head_rows=tuple(heads))
+
+
+def _blockwise(spec: ConeSpec, v: Array):
+    """(t, znorm) per segment: head values and tail 2-norms, (..., C+1)."""
+    C = spec.num_cones + 1
+    tail = jnp.where(spec.is_soc & ~spec.is_head, v, 0.0)
+    base = jnp.zeros(v.shape[:-1] + (C,), v.dtype)
+    zsq = base.at[..., spec.seg].add(tail * tail)
+    t = base.at[..., spec.seg].add(jnp.where(spec.is_head, v, 0.0))
+    return t, jnp.sqrt(zsq)
+
+
+def project_soc_rows(spec: ConeSpec, v: Array) -> Array:
+    """Rowwise Euclidean projection of each SOC block of `v` onto the
+    second-order cone {(t, z): ||z|| <= t}; box rows pass through.
+
+    Cases (per block): interior/boundary (||z|| <= t) identity; polar
+    (||z|| <= -t) zero; else the reflection case
+    proj = (alpha, alpha z/||z||), alpha = (t + ||z||)/2.
+    """
+    t, znorm = _blockwise(spec, v)
+    inside = znorm <= t
+    polar = znorm <= -t
+    alpha = 0.5 * (t + znorm)
+    scale = jnp.where(inside, 1.0,
+                      jnp.where(polar, 0.0,
+                                alpha / jnp.maximum(znorm, _TINY)))
+    t_new = jnp.where(inside, t, jnp.where(polar, 0.0, alpha))
+    row_scale = scale[..., spec.seg]
+    row_t = t_new[..., spec.seg]
+    proj = jnp.where(spec.is_head, row_t, v * row_scale)
+    return jnp.where(spec.is_soc, proj, v)
+
+
+def project_polar_rows(spec: ConeSpec, v: Array) -> Array:
+    """Rowwise projection of SOC blocks onto the POLAR cone -K (SOC is
+    self-dual: -K* = -K); box rows pass through.  By Moreau,
+    Proj_{-K}(v) = v - Proj_K(v)."""
+    return jnp.where(spec.is_soc, v - project_soc_rows(spec, v), v)
+
+
+def dual_prox(spec: ConeSpec, w: Array, sigma: Array,
+              bl: Array, bu: Array) -> Array:
+    """Generalized PDHG dual prox: y1 = w - sigma * Proj_set(w / sigma)
+    with the row set = [bl, bu] on box rows and b + K on SOC blocks
+    (shift b read off bl; bl == bu == b by the ConeSpec contract).
+
+    Division-free via positive homogeneity:
+        box:  y1 = w - clip(w, sigma*bl, sigma*bu)
+        SOC:  y1 = (w - sigma*b) - Proj_K(w - sigma*b)
+            = Proj_polar(w - sigma*b).
+    `sigma` broadcasts over the row axis ((..., 1) from callers)."""
+    box = w - jnp.clip(w, sigma * bl, sigma * bu)
+    shift = jnp.where(spec.is_soc, bl, 0.0)
+    wsh = w - sigma * shift
+    soc = wsh - project_soc_rows(spec, wsh)
+    return jnp.where(spec.is_soc, soc, box)
+
+
+def primal_violation_rows(spec: ConeSpec, ax: Array, bl: Array) -> Array:
+    """Rowwise |ax - Proj_{b+K}(ax)| on SOC rows, 0 on box rows — the
+    conic analog of the box row residual max(ax-bu,0)+max(bl-ax,0)."""
+    shift = jnp.where(spec.is_soc, bl, 0.0)
+    v = ax - shift
+    proj = project_soc_rows(spec, v)
+    return jnp.where(spec.is_soc, jnp.abs(v - proj), 0.0)
+
+
+def dual_cone_residual_rows(spec: ConeSpec, y: Array) -> Array:
+    """Rowwise conic dual-feasibility residual |y - Proj_{-K}(y)| on SOC
+    rows (0 on box rows): the distance of each dual block to the polar
+    cone.  Zero at every PDHG iterate (the prox lands in -K) and at
+    window averages (-K is convex); nonzero flags a warm start or
+    hand-built y whose conic Fenchel accounting is not yet valid, so
+    kkt_residuals folds the max into the dual residual and every
+    bound-publication gate (lagrangian / xhat / fused planes) inherits
+    the check."""
+    return jnp.where(spec.is_soc, jnp.abs(y - project_polar_rows(spec, y)),
+                     0.0)
+
+
+def head_membership(spec: ConeSpec, num_segments: int | None = None):
+    """(C, m) f32 head/tail membership matrices (Mhead, Mtail) — the
+    matmul form of the segment maps, for consumers that cannot scatter
+    (the Pallas VMEM window kernel does blockwise reductions as two
+    small MXU dots against these)."""
+    C = spec.num_cones if num_segments is None else num_segments
+    m = spec.m
+    base = jnp.zeros((C, m), jnp.float32)
+    rows = jnp.arange(m)
+    seg = jnp.clip(spec.seg, 0, C - 1)
+    head = base.at[seg, rows].add(
+        jnp.where(spec.is_soc & spec.is_head, 1.0, 0.0))
+    tail = base.at[seg, rows].add(
+        jnp.where(spec.is_soc & ~spec.is_head, 1.0, 0.0))
+    return head, tail
+
+
+def validate_against_bounds(spec: ConeSpec, bl, bu,
+                            atol: float = 0.0) -> None:
+    """Host-side check of the ConeSpec contract: every SOC row must
+    carry bl == bu (the shift).  Call at build time, not in hot paths."""
+    bl = np.asarray(bl)
+    bu = np.asarray(bu)
+    soc = np.asarray(spec.is_soc)
+    bad = soc & ~(np.abs(bl - bu) <= atol)
+    if bad.reshape(-1, bad.shape[-1]).any():
+        rows = np.nonzero(bad.reshape(-1, bad.shape[-1]).any(0))[0]
+        raise ValueError(
+            f"SOC rows {rows.tolist()} must store their shift in both "
+            "bl and bu (bl == bu); got differing bounds")

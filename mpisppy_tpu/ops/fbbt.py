@@ -32,6 +32,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mpisppy_tpu.ops.boxqp import BoxQP
 
@@ -150,6 +151,81 @@ def _sweep_ell(ell, bl: Array, bu: Array, l: Array, u: Array):
     return l2, u2
 
 
+def _head_activity_max(qp: BoxQP, l: Array, u: Array):  # noqa: E741
+    """(Lmax_finite, has_inf) for the SOC HEAD rows only, (..., C):
+    the finite part of the interval activity upper bound
+    sum_j max(a_ij l_j, a_ij u_j) and whether any term is
+    (symbolically) infinite — same conventions as the sweeps.  Head
+    row indices are STATIC (ConeSpec.head_rows meta), so A is sliced
+    to C rows at trace time instead of reducing over all m rows (the
+    sweeps already pay the full (m, n) pass; the SOC relaxation only
+    needs the heads)."""
+    hr = np.asarray(qp.cones.head_rows, np.int64)
+    lo, hi = _clean(l, u)
+    if hasattr(qp.A, "vals"):
+        vals, cols = qp.A.vals[..., hr, :], qp.A.cols[hr]
+        flat = cols.reshape(-1)
+        gl = jnp.take(lo, flat, axis=-1).reshape(lo.shape[:-1] + cols.shape)
+        gu = jnp.take(hi, flat, axis=-1).reshape(hi.shape[:-1] + cols.shape)
+        t_max = jnp.maximum(vals * gl, vals * gu)
+        pos = vals > 0.0
+        neg = vals < 0.0
+        raw_l = jnp.take(l, flat, axis=-1).reshape(
+            lo.shape[:-1] + cols.shape)
+        raw_u = jnp.take(u, flat, axis=-1).reshape(
+            hi.shape[:-1] + cols.shape)
+        lo_inf = ~(jnp.abs(raw_l) < _BIG)
+        hi_inf = ~(jnp.abs(raw_u) < _BIG)
+    else:
+        A = qp.A[..., hr, :]
+        lo_b = lo[..., None, :]
+        hi_b = hi[..., None, :]
+        t_max = jnp.maximum(A * lo_b, A * hi_b)
+        pos = A > 0.0
+        neg = A < 0.0
+        lo_inf = ~(jnp.abs(l) < _BIG)[..., None, :]
+        hi_inf = ~(jnp.abs(u) < _BIG)[..., None, :]
+    max_inf = (pos & hi_inf) | (neg & lo_inf)
+    Lmax_f = jnp.sum(jnp.where(max_inf, 0.0, t_max), axis=-1)
+    return Lmax_f, jnp.any(max_inf, axis=-1)
+
+
+def _soc_effective_bounds(qp: BoxQP, l: Array, u: Array):  # noqa: E741
+    """CONSERVATIVE row-interval relaxation of SOC blocks (norm-ball
+    bounds) for the sweeps.  The block stores its shift b in bl == bu;
+    treating that as an equality row would be an INVALID tightening
+    (it cuts the cone down to its apex).  Valid implications instead:
+
+      head:  a_h'x - b_h = t >= ||z|| >= 0      ->  row in [b_h, +inf)
+      tails: |a_i'x - b_i| = |z_i| <= ||z|| <= t <= t_ub
+                                               ->  row in [b_i -+ t_ub]
+
+    with t_ub the interval activity upper bound of the head row minus
+    b_h (infinite head activity -> tails stay untightened).  Box rows
+    keep their bounds."""
+    spec = qp.cones
+    hr = np.asarray(spec.head_rows, np.int64)
+    Lmax_h, has_inf_h = _head_activity_max(qp, l, u)   # (..., C)
+    # block b's head is head_rows[b] (cone_spec order), so the head
+    # activities ARE the per-block values — no segment scatter needed;
+    # a zero sentinel column serves the box rows' seg gather below
+    room = Lmax_h - qp.bl[..., hr]
+    bshape = Lmax_h.shape[:-1]
+    pad = jnp.zeros(bshape + (1,), Lmax_h.dtype)
+    blk = jnp.concatenate(
+        [jnp.broadcast_to(room, bshape + room.shape[-1:]), pad], axis=-1)
+    blk_inf = jnp.concatenate(
+        [jnp.broadcast_to(has_inf_h.astype(Lmax_h.dtype),
+                          bshape + (spec.num_cones,)), pad], axis=-1)
+    inf = jnp.asarray(jnp.inf, Lmax_h.dtype)
+    t_ub = jnp.where(blk_inf[..., spec.seg] > 0.0, inf,
+                     jnp.maximum(blk[..., spec.seg], 0.0))
+    bl_eff = jnp.where(spec.is_soc & ~spec.is_head, qp.bl - t_ub, qp.bl)
+    bu_eff = jnp.where(spec.is_soc,
+                       jnp.where(spec.is_head, inf, qp.bu + t_ub), qp.bu)
+    return bl_eff, bu_eff
+
+
 @partial(jax.jit, static_argnames=("n_sweeps",))
 def fbbt(qp: BoxQP, n_sweeps: int = 3,
          d_col: Array | None = None,
@@ -179,10 +255,16 @@ def fbbt(qp: BoxQP, n_sweeps: int = 3,
 
     def body(_, lu):
         l, u = lu  # noqa: E741
-        if hasattr(qp.A, "vals"):
-            l, u = _sweep_ell(qp.A, qp.bl, qp.bu, l, u)  # noqa: E741
+        if qp.cones is None:
+            bl, bu = qp.bl, qp.bu
         else:
-            l, u = _sweep_dense(qp.A, qp.bl, qp.bu, l, u)  # noqa: E741
+            # re-relaxed EVERY sweep: the norm-ball widths shrink as the
+            # head rows' activity bounds tighten
+            bl, bu = _soc_effective_bounds(qp, l, u)
+        if hasattr(qp.A, "vals"):
+            l, u = _sweep_ell(qp.A, bl, bu, l, u)  # noqa: E741
+        else:
+            l, u = _sweep_dense(qp.A, bl, bu, l, u)  # noqa: E741
         return round_int(l, u)
 
     l, u = jax.lax.fori_loop(0, n_sweeps, body, round_int(l0, u0))  # noqa: E741

@@ -193,13 +193,22 @@ def _iter_precision(opts: PDHGOptions):
 
 def _pdhg_iter(p: BoxQP, st: PDHGState, tau: Array, sigma: Array,
                precision=None) -> PDHGState:
-    """One PDHG step; frozen for problems already `done`."""
+    """One PDHG step; frozen for problems already `done`.
+
+    The dual prox dispatches per row at TRACE time: pure box problems
+    (p.cones is None) keep the two-sided clip; conic problems route
+    through ops.cones.dual_prox, which clips box rows and applies the
+    Moreau second-order-cone projection blockwise on SOC rows."""
     t = tau[..., None]
     s = sigma[..., None]
     v = st.x - t * p.rmatvec(st.y, precision=precision)
     x1 = jnp.clip((v - t * p.c) / (1.0 + t * p.q), p.l, p.u)
     w = st.y + s * p.matvec(2.0 * x1 - st.x, precision=precision)
-    y1 = w - s * jnp.clip(w / s, p.bl, p.bu)
+    if p.cones is None:
+        y1 = w - s * jnp.clip(w / s, p.bl, p.bu)
+    else:
+        from mpisppy_tpu.ops import cones as cones_mod
+        y1 = cones_mod.dual_prox(p.cones, w, s, p.bl, p.bu)
     keep = st.done[..., None]
     x1 = jnp.where(keep, st.x, x1)
     y1 = jnp.where(keep, st.y, y1)

@@ -100,7 +100,7 @@ def _dot3(v_split, M_hi, M_lo):
     return acc
 
 
-def _window_kernel(n_iters: int, precision, *refs):
+def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
     """All n_iters PDHG iterations for one scenario tile, VMEM-resident.
 
     Math matches the XLA path (ops/pdhg.py _pdhg_iter) up to float
@@ -108,8 +108,14 @@ def _window_kernel(n_iters: int, precision, *refs):
         v  = x - tau * A'y
         x1 = clip((v - tau c) / (1 + tau q), l, u)
         w  = y + sigma * A (2 x1 - x)
-        y1 = w - sigma * clip(w / sigma, bl, bu)
+        y1 = w - sigma * clip(w / sigma, bl, bu)   (box rows)
+        y1 = Proj_polar(w - sigma*b)               (SOC rows)
     with `done` scenarios frozen and window sums accumulated.
+
+    SOC blockwise reductions (per-block head value / tail norm and the
+    scatter back to rows) run as small MXU dots against 0/1 membership
+    matrices (ops.cones.head_membership) — Mosaic has no scatter, but a
+    (T, m) x (m, C) dot IS a segment sum with static shapes.
     """
     three_pass = precision == jax.lax.Precision.HIGH
     # matrix refs are present only for the precision mode in use (2 for
@@ -119,8 +125,11 @@ def _window_kernel(n_iters: int, precision, *refs):
     (tau_ref, sigma_ref, done_ref,
      c_ref, q_ref, l_ref, u_ref, bl_ref, bu_ref) = refs[:9]
     mat_refs = refs[9:9 + nmat]
+    k = 9 + nmat
+    cone_refs = refs[k:k + 7] if has_cones else ()
+    k += 7 if has_cones else 0
     (x0_ref, y0_ref, xs0_ref, ys0_ref,
-     x_ref, y_ref, xs_ref, ys_ref) = refs[9 + nmat:]
+     x_ref, y_ref, xs_ref, ys_ref) = refs[k:]
 
     live = 1.0 - done_ref[:]  # (T, 1) 1.0 = still running
     # Done-masking folds into the step sizes: with tau = sigma = 0 the
@@ -173,6 +182,45 @@ def _window_kernel(n_iters: int, precision, *refs):
                 v, _AT, (((1,), (0,)), ((), ())),
                 precision=hp, preferred_element_type=jnp.float32)
 
+    if has_cones:
+        (shift_ref, soc_ref, head_ref,
+         mh_ref, mht_ref, mt_ref, mtt_ref) = cone_refs
+        shift = shift_ref[:]          # (T|1, m)
+        socm = soc_ref[:]             # (1, m) f32 masks
+        headm = head_ref[:]
+        tailm = socm - headm
+        Mhead = mh_ref[:]             # (C, m)
+        MheadT = mht_ref[:]           # (m, C)
+        Mtail = mt_ref[:]
+        MtailT = mtt_ref[:]
+        dims = (((1,), (0,)), ((), ()))
+
+        def xdot(a, b):
+            return jax.lax.dot_general(
+                a, b, dims, precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+
+        def soc_prox(w, y):
+            """Proj_polar(w - sigma*shift) on SOC rows, frozen-exact:
+            the tau=sigma=0 freeze trick does NOT make the cone branch
+            a no-op (Proj_polar(y) != y in general), so frozen
+            scenarios blend back to y explicitly via `live`."""
+            wsh = w - sigma * shift
+            blk = xdot(wsh * wsh * tailm, MtailT)      # (T, C) sum z^2
+            tvals = xdot(wsh * headm, MheadT)          # (T, C) head t
+            znorm = jnp.sqrt(blk)
+            inside = znorm <= tvals
+            pol = znorm <= -tvals
+            alpha = 0.5 * (tvals + znorm)
+            scale = jnp.where(inside, 1.0,
+                              jnp.where(pol, 0.0,
+                                        alpha / jnp.maximum(znorm, 1e-30)))
+            tnew = jnp.where(inside, tvals, jnp.where(pol, 0.0, alpha))
+            proj = headm * xdot(tnew, Mhead) \
+                + tailm * (wsh * xdot(scale, Mtail))
+            y_soc = wsh - proj
+            return y + live * (y_soc - y)
+
     def body(_, carry):
         x, y, xs, ys = carry
         aty = rmv(y)            # A'y -> (T, n)
@@ -180,6 +228,8 @@ def _window_kernel(n_iters: int, precision, *refs):
         ax = mv(2.0 * x1 - x)   # A(2x1 - x) -> (T, m)
         w = y + sigma * ax
         y1 = w - jnp.clip(w, sbl, sbu)
+        if has_cones:
+            y1 = jnp.where(socm > 0.0, soc_prox(w, y), y1)
         return x1, y1, xs + x1, ys + y1
 
     x, y, xs, ys = jax.lax.fori_loop(
@@ -191,8 +241,27 @@ def _window_kernel(n_iters: int, precision, *refs):
     ys_ref[:] = ys
 
 
+def _membership_padded(spec, m: int, m_p: int, dt):
+    """(Mhead, MheadT, Mtail, MtailT) padded to (C_p, m_p).  Built
+    inline per trace: run_window is jitted, so this runs once per
+    compilation (not once per window) and XLA's compilation cache
+    amortizes it — do NOT add a host-side cache here, the spec is a
+    freshly-unflattened tracer pytree on every trace (unhashable,
+    fresh id()), so caching can only leak tracers, never hit."""
+    from mpisppy_tpu.ops import cones as cones_mod
+    C_p = _round_up(max(spec.num_cones, 1), 128)
+    Mhead, Mtail = cones_mod.head_membership(spec)
+    Mhead = jnp.pad(Mhead.astype(dt),
+                    ((0, C_p - spec.num_cones), (0, m_p - m)))
+    Mtail = jnp.pad(Mtail.astype(dt),
+                    ((0, C_p - spec.num_cones), (0, m_p - m)))
+    return (Mhead, Mhead.T, Mtail, Mtail.T)
+
+
 def supported(p) -> bool:
-    """Dense SHARED constraint matrix with a (S,)-batched problem."""
+    """Dense SHARED constraint matrix with a (S,)-batched problem.
+    Conic problems (p.cones set) are supported: the kernel runs the SOC
+    dual prox via membership-matrix dots (see _window_kernel)."""
     A = p.A
     return (isinstance(A, jax.Array) or isinstance(A, np.ndarray)) \
         and getattr(A, "ndim", 0) == 2 and p.c.ndim == 2
@@ -279,6 +348,19 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
     sigma_p = prep_s(sigma, 1.0)
     done_p = prep_s(done.astype(dt), 1.0)  # pad scenarios frozen
 
+    has_cones = p.cones is not None
+    cone_ops = ()
+    if has_cones:
+        spec = p.cones
+        # shift: bl on SOC rows (bl == bu == b by the ConeSpec contract),
+        # 0 elsewhere; may be shared (m,) or per-scenario (S, m)
+        shift = jnp.where(spec.is_soc, jnp.asarray(p.bl, dt), 0.0)
+        shift_p = prep(shift, m_p, 0.0)
+        socm = prep(spec.is_soc.astype(dt), m_p, 0.0)
+        headm = prep(spec.is_head.astype(dt), m_p, 0.0)
+        cone_ops = (shift_p, socm, headm) \
+            + _membership_padded(spec, m, m_p, dt)
+
     grid = (S_p // tile_s,)
 
     def vspec(arr, width):
@@ -308,19 +390,27 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
     out_specs = [ospec(n_p), ospec(m_p), ospec(n_p), ospec(m_p)]
 
     mat_specs = [aspec, atspec] * (len(mats) // 2)
+    cone_specs = []
+    if has_cones:
+        mspec = pl.BlockSpec((cone_ops[3].shape[0], m_p), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)
+        mtspec = pl.BlockSpec((m_p, cone_ops[3].shape[0]), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+        cone_specs = [vspec(cone_ops[0], m_p), vspec(cone_ops[1], m_p),
+                      vspec(cone_ops[2], m_p), mspec, mtspec, mspec, mtspec]
     xo, yo, xso, yso = pl.pallas_call(
-        partial(_window_kernel, n_iters, prec),
+        partial(_window_kernel, n_iters, prec, has_cones),
         grid=grid,
         in_specs=[sspec, sspec, sspec,
                   vspec(c, n_p), vspec(q, n_p), vspec(l, n_p), vspec(u, n_p),
                   vspec(bl, m_p), vspec(bu, m_p),
-                  *mat_specs,
+                  *mat_specs, *cone_specs,
                   vspec(x_p, n_p), vspec(y_p, m_p),
                   vspec(xs_p, n_p), vspec(ys_p, m_p)],
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(tau_p, sigma_p, done_p, c, q, l, u, bl, bu, *mats,
+    )(tau_p, sigma_p, done_p, c, q, l, u, bl, bu, *mats, *cone_ops,
       x_p, y_p, xs_p, ys_p)
 
     return (xo[:S, :n], yo[:S, :m], xso[:S, :n], yso[:S, :m])

@@ -212,11 +212,18 @@ def ell_from_scipy_batch(mats, dtype=jnp.float32) -> EllMatrix:
 
 
 def ruiz_scale_ell(vals: np.ndarray, cols: np.ndarray, n: int,
-                   iters: int = 10) -> tuple[np.ndarray, np.ndarray,
-                                             np.ndarray]:
+                   iters: int = 10, cones=None) -> tuple[np.ndarray,
+                                                         np.ndarray,
+                                                         np.ndarray]:
     """Host-side Ruiz equilibration in ELL form (the sparse analog of
     ops.boxqp.ruiz_scale's loop).  Returns (scaled_vals, d_row, d_col);
-    batched vals get per-batch scalings."""
+    batched vals get per-batch scalings.
+
+    `cones` (an ops.cones.ConeSpec) forces block-UNIFORM row scales on
+    SOC blocks — per-row scaling would break ||z|| <= t — exactly like
+    the dense path (boxqp.group_row_scales); the ELL assembly otherwise
+    carries SOC metadata untouched (the cone partition lives on the
+    BoxQP, the sparsity pattern here)."""
     vals = np.asarray(vals, np.float64).copy()
     bshape = vals.shape[:-2]
     m = vals.shape[-2]
@@ -229,6 +236,9 @@ def ruiz_scale_ell(vals: np.ndarray, cols: np.ndarray, n: int,
         # path would compound to overflow across iterations here, since
         # ELL problems legitimately have columns absent from A)
         rmax = np.where(rmax <= 1e-12, 1.0, rmax)
+        if cones is not None:
+            from mpisppy_tpu.ops.boxqp import group_row_scales
+            rmax = group_row_scales(rmax, cones)
         vals /= np.sqrt(rmax)[..., None]
         dr /= np.sqrt(rmax)
         # one flattened scatter-max for the whole batch: index

@@ -95,7 +95,11 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
     # loop reliably wedges/crashes the axon TPU worker on these
     # instances (observed repeatedly, round 5); the multistart + LNS
     # polish provides the incumbent quality instead
-    eval_opts = bnb.BnBOptions(max_rounds=400, pump_rounds=0)
+    # swap repair enabled explicitly: this is final-candidate
+    # certification (the polish context the default-0 swap_rounds
+    # reserves it for)
+    eval_opts = bnb.BnBOptions(max_rounds=400, pump_rounds=0,
+                               swap_rounds=bnb.POLISH_SWAP_ROUNDS)
     lag_opts = bnb.BnBOptions(max_rounds=240, pump_rounds=0)
 
     # -- 4. candidate pool + batched MIP evaluation ------------------------

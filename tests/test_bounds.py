@@ -93,11 +93,13 @@ def test_xhat_infeasible_candidate(farmer3):
 def test_xhat_shuffle(farmer3, ph_solved):
     x_non = farmer3.nonants(ph_solved.state.solver.x)
     ids = jnp.asarray([0, 1, 2])
-    vals, feas, _ = xhat_mod.xhat_shuffle(farmer3, x_non, ids, 3,
-                                          pdhg.PDHGOptions(tol=1e-6))
+    vals, feas, _, comps = xhat_mod.xhat_shuffle(farmer3, x_non, ids, 3,
+                                                 pdhg.PDHGOptions(tol=1e-6))
     assert bool(feas.all())
     # every candidate evaluation is a valid upper bound (f32 slack)
     assert float(jnp.min(vals)) >= FARMER_EF_OBJ - 2e-3 * abs(FARMER_EF_OBJ)
+    # converged evaluations carry (near) zero first-order compensation
+    assert float(jnp.max(comps)) <= 1e-3 * abs(FARMER_EF_OBJ)
 
 
 def test_slam_heuristic(farmer3, ph_solved):
@@ -118,3 +120,81 @@ def test_subgradient_improves(farmer3):
     assert float(st.best_bound) <= FARMER_EF_OBJ + 2e-3 * abs(FARMER_EF_OBJ)
     # best bound beats L(0) (wait-and-see)
     assert float(st.best_bound) > -115405.0
+
+
+# ---------------------------------------------------------------------------
+# comp-tightness publication gate (ADVICE r5: the evaluators' first-
+# order infeasibility compensation must be gated like every other
+# publication path — fused _eval_step, EFXhatInnerBound)
+# ---------------------------------------------------------------------------
+def _mk_result(batch, comp, value):
+    S = batch.num_scenarios
+    return xhat_mod.XhatResult(
+        value=jnp.asarray(value, jnp.float32),
+        per_scenario=jnp.zeros(S, jnp.float32),
+        feasible=jnp.asarray(np.isfinite(value)),
+        primal_resid=jnp.zeros(S, jnp.float32),
+        status=jnp.zeros(S, jnp.int32),
+        comp=jnp.full((S,), comp, jnp.float32))
+
+
+def test_comp_tight_gate(farmer3):
+    assert xhat_mod.comp_tight(farmer3, _mk_result(farmer3, 0.0, -100.0))
+    # loose compensation (50% of the value) must NOT publish
+    assert not xhat_mod.comp_tight(farmer3, _mk_result(farmer3, 50.0,
+                                                       -100.0))
+    assert not xhat_mod.comp_tight(farmer3, _mk_result(farmer3, 0.0,
+                                                       np.inf))
+    # the gate is RELATIVE: the same absolute comp passes at large |value|
+    assert xhat_mod.comp_tight(farmer3, _mk_result(farmer3, 0.15,
+                                                   -1000.0))
+    assert not xhat_mod.comp_tight(farmer3, _mk_result(farmer3, 0.15,
+                                                       -10.0))
+
+
+def test_inner_spoke_harvest_gates_on_comp(farmer3):
+    """InnerBoundSpoke.harvest withholds a feasible-but-loose value
+    (regression: the blocking warm-rescue path used to publish through
+    this gate-free, the hydro +37% case)."""
+    from mpisppy_tpu.cylinders.spoke import InnerBoundSpoke
+
+    class _Opt:
+        batch = farmer3
+
+    xhat = np.zeros(farmer3.num_nonants)
+    spoke = InnerBoundSpoke(_Opt())
+    spoke._pending = (_mk_result(farmer3, 50.0, -100.0), xhat)
+    assert spoke.harvest() is None          # loose: withheld
+    spoke._pending = (_mk_result(farmer3, 0.0, -100.0), xhat)
+    assert spoke.harvest() == pytest.approx(-100.0)   # tight: published
+
+
+def test_evaluators_return_safety_scaled_comp(farmer3, ph_solved):
+    """The evaluators expose the (safety-scaled, xhat.COMP_SAFETY)
+    compensation their published values already include; converged
+    solves carry ~zero."""
+    from mpisppy_tpu.ops import boxqp
+
+    assert xhat_mod.COMP_SAFETY >= 2.0
+    _, nodes = farmer3.node_average(
+        farmer3.nonants(ph_solved.state.solver.x))
+    res = xhat_mod.evaluate(farmer3, nodes[0],
+                            pdhg.PDHGOptions(tol=1e-7))
+    assert bool(res.feasible)
+    assert float(jnp.max(res.comp)) >= 0.0
+    assert xhat_mod.comp_tight(farmer3, res)
+    # behavioral contract: comp IS the safety-scaled exact-penalty term
+    # COMP_SAFETY * sum(|y| * viol) of the returned solver state.  A
+    # deliberately truncated warm solve (loose tol, tiny budget, and a
+    # generous feas_tol so the stalled-tail rescue stays out of the
+    # way) leaves nonzero violation to scale.
+    qp = farmer3.with_fixed_nonants(nodes[0])
+    lo_opts = pdhg.PDHGOptions(tol=1e-2, max_iters=100)
+    res_w, st = xhat_mod.evaluate_warm(
+        farmer3, nodes[0], pdhg.init_state(qp, lo_opts), lo_opts,
+        feas_tol=1e6)
+    expect = xhat_mod.COMP_SAFETY * np.sum(
+        np.abs(np.asarray(st.y))
+        * np.asarray(boxqp.primal_residual(qp, st.x)), axis=-1)
+    assert np.allclose(np.asarray(res_w.comp), expect,
+                       rtol=1e-5, atol=1e-7)

@@ -1,0 +1,466 @@
+# Second-order-cone subsystem (ops/cones.py): Moreau projections
+# against closed forms and scipy references, the conic PDHG kernel and
+# its certificates, FBBT's conservative norm-ball relaxation of SOC
+# blocks, metadata threading through batch/EF assembly, and the ccopf
+# --soc (branch-flow SOCP relaxation) workload end to end on the
+# virtual 8-device CPU mesh.
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.models import ccopf
+from mpisppy_tpu.ops import boxqp, cones, pdhg
+from mpisppy_tpu.ops.fbbt import fbbt
+
+
+# ---------------------------------------------------------------------------
+# projection unit tests
+# ---------------------------------------------------------------------------
+def np_soc_project(v):
+    """Closed-form numpy reference: Euclidean projection of (t; z) onto
+    the second-order cone {(t, z): ||z|| <= t}."""
+    t, z = float(v[0]), np.asarray(v[1:], np.float64)
+    nz = float(np.linalg.norm(z))
+    if nz <= t:
+        return np.asarray(v, np.float64).copy()
+    if nz <= -t:
+        return np.zeros_like(np.asarray(v, np.float64))
+    a = 0.5 * (t + nz)
+    return np.concatenate([[a], a * z / max(nz, 1e-30)])
+
+
+def one_block_spec(dim, m_extra=0):
+    """ConeSpec with a single SOC block on rows [0, dim) and m_extra
+    trailing box rows."""
+    return cones.cone_spec(dim + m_extra, [np.arange(dim)])
+
+
+def test_project_closed_form_cases():
+    spec = one_block_spec(3, m_extra=2)
+    cases = [
+        (np.array([2.0, 1.0, 1.0]), None),            # interior: identity
+        (np.array([np.sqrt(2.0), 1.0, 1.0]), None),   # boundary: identity
+        (np.array([-2.0, 1.0, 1.0]), np.zeros(3)),    # polar: zero
+        # reflection: ||z|| = 5 > |t|, alpha = (0 + 5)/2 = 2.5
+        (np.array([0.0, 3.0, 4.0]), np.array([2.5, 1.5, 2.0])),
+        (np.array([-1.0, 0.0, 3.0]), np.array([1.0, 0.0, 1.0])),
+    ]
+    for v_blk, want in cases:
+        if want is None:
+            want = v_blk
+        v = jnp.asarray(np.concatenate([v_blk, [7.0, -3.0]]), jnp.float32)
+        out = np.asarray(cones.project_soc_rows(spec, v))
+        np.testing.assert_allclose(out[:3], want, atol=1e-6)
+        # box rows pass through untouched
+        np.testing.assert_allclose(out[3:], [7.0, -3.0], atol=0.0)
+
+
+def test_project_matches_scipy_reference():
+    from scipy.optimize import minimize
+
+    rng = np.random.default_rng(0)
+    spec = one_block_spec(5)
+    for _ in range(6):
+        v = rng.normal(scale=2.0, size=5)
+        ours = np.asarray(
+            cones.project_soc_rows(spec, jnp.asarray(v, jnp.float64)))
+
+        def dist(p, v=v):
+            return np.sum((p - v) ** 2)
+
+        ref = minimize(
+            dist, np_soc_project(v) + 1e-3,
+            constraints=[{"type": "ineq",
+                          "fun": lambda p: p[0] ** 2
+                          - np.sum(p[1:] ** 2)},
+                         {"type": "ineq", "fun": lambda p: p[0]}],
+            method="SLSQP", tol=1e-12)
+        np.testing.assert_allclose(ours, ref.x, atol=1e-4)
+        np.testing.assert_allclose(ours, np_soc_project(v), atol=1e-5)
+
+
+def test_moreau_identity_and_orthogonality_batched():
+    """v = Proj_K(v) + Proj_{-K}(v) with the parts orthogonal, on a
+    batched ragged multi-block layout (box rows interleaved)."""
+    rng = np.random.default_rng(1)
+    # rows 0-2 block A, row 3 box, rows 4-8 block B, row 9 box
+    spec = cones.cone_spec(10, [np.arange(3), np.arange(4, 9)])
+    v = jnp.asarray(rng.normal(scale=3.0, size=(4, 10)), jnp.float64)
+    pk = np.asarray(cones.project_soc_rows(spec, v))
+    pp = np.asarray(cones.project_polar_rows(spec, v))
+    soc = np.asarray(spec.is_soc)
+    np.testing.assert_allclose((pk + pp)[:, soc], np.asarray(v)[:, soc],
+                               atol=1e-5)
+    for blk in (slice(0, 3), slice(4, 9)):
+        dots = np.sum(pk[:, blk] * pp[:, blk], axis=-1)
+        np.testing.assert_allclose(dots, 0.0, atol=1e-4)
+        # projections land in their cones
+        assert np.all(np.linalg.norm(pk[:, blk][:, 1:], axis=-1)
+                      <= pk[:, blk][:, 0] + 1e-5)
+        assert np.all(np.linalg.norm(pp[:, blk][:, 1:], axis=-1)
+                      <= -pp[:, blk][:, 0] + 1e-5)
+
+
+def test_dual_prox_equals_division_form():
+    """dual_prox's division-free form == w - sigma*Proj_set(w/sigma)
+    computed naively per row set (box interval / shifted cone)."""
+    rng = np.random.default_rng(2)
+    spec = cones.cone_spec(7, [np.arange(2, 6)])
+    w = rng.normal(scale=2.0, size=(3, 7))
+    sigma = rng.uniform(0.2, 3.0, size=(3, 1))
+    b = rng.normal(size=7)
+    bl = np.where(np.asarray(spec.is_soc), b, -1.0)
+    bu = np.where(np.asarray(spec.is_soc), b, 2.0)
+    got = np.asarray(cones.dual_prox(
+        spec, jnp.asarray(w), jnp.asarray(sigma), jnp.asarray(bl),
+        jnp.asarray(bu)))
+    for i in range(3):
+        ws = w[i] / sigma[i]
+        proj = np.clip(ws, bl, bu)
+        proj[2:6] = b[2:6] + np_soc_project(ws[2:6] - b[2:6])
+        np.testing.assert_allclose(got[i], w[i] - sigma[i] * proj,
+                                   atol=1e-5)
+
+
+def test_cone_spec_validation():
+    with pytest.raises(ValueError, match="overlaps"):
+        cones.cone_spec(6, [np.arange(3), np.arange(2, 6)])
+    with pytest.raises(ValueError, match="head"):
+        cones.cone_spec(6, [np.array([4])])
+    # duplicate rows WITHIN a block collapse in the fancy assignments
+    # and would silently build a looser cone — rejected at build time
+    with pytest.raises(ValueError, match="duplicate"):
+        cones.cone_spec(8, [np.array([5, 7, 7])])
+    spec = cones.cone_spec(4, [np.arange(3)])
+    with pytest.raises(ValueError, match="shift"):
+        cones.validate_against_bounds(
+            spec, np.zeros(4), np.array([0.0, 1.0, 0.0, 5.0]))
+    # bl == bu on SOC rows is fine; box rows may differ freely
+    cones.validate_against_bounds(
+        spec, np.zeros(4), np.array([0.0, 0.0, 0.0, 5.0]))
+
+
+# ---------------------------------------------------------------------------
+# conic PDHG + certificates
+# ---------------------------------------------------------------------------
+def conic_lp_batch(caps=(1.5, 0.9)):
+    """max x1 + x2 - 0.1 x0  s.t.  ||(x1, x2)|| <= x0 <= cap_s, as a
+    min problem — optimum at x0 = cap, x1 = x2 = cap/sqrt(2).  Rows:
+    one inactive box row then the 3-row SOC block (head first)."""
+    S = len(caps)
+    n = 3
+    c = np.tile([0.1, -1.0, -1.0], (S, 1))
+    A = np.array([[0.0, 1.0, 1.0],     # box: x1 + x2 <= 10
+                  [1.0, 0.0, 0.0],     # head: t = x0
+                  [0.0, 1.0, 0.0],     # tail z1 = x1
+                  [0.0, 0.0, 1.0]])    # tail z2 = x2
+    bl = np.tile([-np.inf, 0.0, 0.0, 0.0], (S, 1))
+    bu = np.tile([10.0, 0.0, 0.0, 0.0], (S, 1))
+    l = np.tile([0.0, -5.0, -5.0], (S, 1))  # noqa: E741
+    u = np.stack([[cap, 5.0, 5.0] for cap in caps])
+    spec = cones.cone_spec(4, [np.arange(1, 4)])
+    qp = boxqp.BoxQP(
+        c=jnp.asarray(c, jnp.float32), q=jnp.zeros((S, n), jnp.float32),
+        A=jnp.asarray(A, jnp.float32),
+        bl=jnp.asarray(bl, jnp.float32), bu=jnp.asarray(bu, jnp.float32),
+        l=jnp.asarray(l, jnp.float32), u=jnp.asarray(u, jnp.float32),
+        cones=spec)
+    x_star = np.stack([[cap, cap / np.sqrt(2.0), cap / np.sqrt(2.0)]
+                       for cap in caps])
+    obj_star = np.sum(c * x_star, axis=-1)
+    return qp, x_star, obj_star
+
+
+def test_conic_pdhg_solves_and_certifies():
+    qp, x_star, obj_star = conic_lp_batch()
+    opts = pdhg.PDHGOptions(tol=1e-7, max_iters=40_000)
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    assert bool(np.all(np.asarray(st.done)))
+    x = np.asarray(st.x)
+    np.testing.assert_allclose(x, x_star, atol=2e-4)
+    rp, rd, gap = (np.asarray(r)
+                   for r in boxqp.kkt_residuals(qp, st.x, st.y))
+    assert rp.max() <= 1e-5 and rd.max() <= 1e-5 and gap.max() <= 1e-5
+    # dual iterates lie in the polar cone by construction (dual_prox)
+    dcr = np.asarray(cones.dual_cone_residual_rows(qp.cones, st.y))
+    np.testing.assert_allclose(dcr, 0.0, atol=1e-6)   # 0 up to f32 ulps
+    # weak duality: the certified Fenchel bound sits just under the
+    # primal objective at the optimum
+    obj = np.asarray(jnp.sum(qp.c * st.x, axis=-1))
+    dual = np.asarray(boxqp.certified_dual_bound(qp, st.x, st.y))
+    assert np.all(dual <= obj + 1e-4)
+    np.testing.assert_allclose(dual, obj_star, atol=2e-3)
+
+
+def test_conic_matches_scipy_reference():
+    from scipy.optimize import minimize
+
+    qp, _, _ = conic_lp_batch(caps=(1.3,))
+    opts = pdhg.PDHGOptions(tol=1e-7, max_iters=40_000)
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    c = np.asarray(qp.c)[0]
+
+    ref = minimize(
+        lambda x: float(c @ x), np.array([1.0, 0.5, 0.5]),
+        constraints=[{"type": "ineq",
+                      "fun": lambda x: x[0] - np.linalg.norm(x[1:])}],
+        bounds=[(0.0, 1.3), (-5.0, 5.0), (-5.0, 5.0)],
+        method="SLSQP", tol=1e-12)
+    assert float(jnp.sum(qp.c[0] * st.x[0])) == pytest.approx(
+        float(ref.fun), abs=5e-4)
+
+
+def test_conic_dual_residual_gates_certificates():
+    """A hand-built y OFF the polar cone must show up in rel_dual (the
+    conic dual-feasibility residual is folded into kkt_residuals), so
+    every downstream bound-publication gate inherits the check."""
+    qp, x_star, _ = conic_lp_batch()
+    opts = pdhg.PDHGOptions(tol=1e-7, max_iters=40_000)
+    st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
+    _, rd_good, _ = boxqp.kkt_residuals(qp, st.x, st.y)
+    # push the SOC block's dual INTO the cone interior (not the polar):
+    y_bad = st.y.at[:, 1].set(3.0)
+    _, rd_bad, _ = boxqp.kkt_residuals(qp, st.x, y_bad)
+    assert float(np.max(np.asarray(rd_good))) <= 1e-5
+    assert float(np.min(np.asarray(rd_bad))) >= 0.1
+    # certified_dual_bound projects such a y back to the polar cone
+    # first, so it stays a VALID (if weaker) bound rather than garbage
+    obj = np.asarray(jnp.sum(qp.c * st.x, axis=-1))
+    dual_bad = np.asarray(boxqp.certified_dual_bound(qp, st.x, y_bad))
+    assert np.all(dual_bad <= obj + 1e-4)
+
+
+def test_unboundedness_recession_accepts_conic_ray():
+    """The recession cone of b + K is K: a direction whose block lies
+    IN the cone is a legitimate ray (the box bl==bu test would demand
+    Ad == 0 and miss it)."""
+    # min -x0 with x0 free above, SOC block (x0; x1) i.e. x0 >= |x1|
+    spec = cones.cone_spec(2, [np.arange(2)])
+    qp = boxqp.BoxQP(
+        c=jnp.asarray([[-1.0, 0.0]], jnp.float32),
+        q=jnp.zeros((1, 2), jnp.float32),
+        A=jnp.eye(2, dtype=jnp.float32),
+        bl=jnp.zeros((1, 2), jnp.float32),
+        bu=jnp.zeros((1, 2), jnp.float32),
+        l=jnp.asarray([[0.0, -50.0]], jnp.float32),
+        u=jnp.asarray([[jnp.inf, 50.0]], jnp.float32),
+        cones=spec)
+    d = jnp.asarray([[1.0, 0.0]], jnp.float32)   # ray: grow the head
+    ok = boxqp.unboundedness_certificate(qp, d)
+    assert bool(np.asarray(ok)[0])
+
+
+# ---------------------------------------------------------------------------
+# FBBT on SOC blocks
+# ---------------------------------------------------------------------------
+def test_fbbt_soc_norm_ball_bounds():
+    """head t = x0 in [0, 5], tail z = x1 unbounded: FBBT must derive
+    |x1| <= 5 (norm-ball) — and must NOT treat the bl==bu==0 storage as
+    an equality (which would pin x1 = 0, an invalid tightening)."""
+    spec = cones.cone_spec(2, [np.arange(2)])
+    qp = boxqp.BoxQP(
+        c=jnp.zeros((1, 2), jnp.float32), q=jnp.zeros((1, 2), jnp.float32),
+        A=jnp.eye(2, dtype=jnp.float32),
+        bl=jnp.zeros((1, 2), jnp.float32),
+        bu=jnp.zeros((1, 2), jnp.float32),
+        l=jnp.asarray([[0.0, -jnp.inf]], jnp.float32),
+        u=jnp.asarray([[5.0, jnp.inf]], jnp.float32),
+        cones=spec)
+    l1, u1 = fbbt(qp, n_sweeps=3)
+    l1, u1 = np.asarray(l1)[0], np.asarray(u1)[0]
+    assert l1[1] == pytest.approx(-5.0, abs=1e-5)
+    assert u1[1] == pytest.approx(5.0, abs=1e-5)
+    # every point of the cone's slice (t, z) with |z| <= t <= 5 remains
+    # inside the tightened box — validity, not just non-collapse
+    assert l1[0] <= 0.0 + 1e-6 and u1[0] >= 5.0 - 1e-5
+
+
+def test_fbbt_soc_unbounded_head_leaves_tails_alone():
+    spec = cones.cone_spec(2, [np.arange(2)])
+    qp = boxqp.BoxQP(
+        c=jnp.zeros((1, 2), jnp.float32), q=jnp.zeros((1, 2), jnp.float32),
+        A=jnp.eye(2, dtype=jnp.float32),
+        bl=jnp.zeros((1, 2), jnp.float32),
+        bu=jnp.zeros((1, 2), jnp.float32),
+        l=jnp.asarray([[0.0, -jnp.inf]], jnp.float32),
+        u=jnp.asarray([[jnp.inf, jnp.inf]], jnp.float32),
+        cones=spec)
+    l1, u1 = fbbt(qp, n_sweeps=2)
+    assert not np.isfinite(np.asarray(l1)[0, 1])
+    assert not np.isfinite(np.asarray(u1)[0, 1])
+
+
+def test_fbbt_soc_bounds_stay_valid_on_ccopf():
+    """FBBT-tightened boxes on the ccopf SOC workload must contain the
+    conic optimum (the sweeps' norm-ball relaxation is conservative)."""
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(3)]
+    b = batch_mod.from_specs(specs, tree=ccopf.make_tree((3, 1)))
+    opts = pdhg.PDHGOptions(tol=1e-6, max_iters=30_000)
+    st = pdhg.solve(b.qp, opts, pdhg.init_state(b.qp, opts))
+    assert bool(np.all(np.asarray(st.done)))
+    l1, u1 = fbbt(b.qp, n_sweeps=3, d_col=b.d_col)
+    x = np.asarray(st.x)
+    slack = 1e-3
+    assert np.all(x >= np.asarray(l1) - slack)
+    assert np.all(x <= np.asarray(u1) + slack)
+    assert np.all(np.asarray(l1) <= np.asarray(u1) + 1e-6)
+
+
+# ---------------------------------------------------------------------------
+# metadata threading: batch / EF assembly, scaling invariance
+# ---------------------------------------------------------------------------
+def test_batch_carries_cone_spec_and_ruiz_respects_blocks():
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(3)]
+    b = batch_mod.from_specs(specs, tree=ccopf.make_tree((3, 1)))
+    spec = b.qp.cones
+    assert spec is not None
+    assert spec.num_cones == 9 and spec.max_dim == 4    # 3 lines x 3 stages
+    # Ruiz equilibration kept the bl == bu == b storage exact on SOC
+    # rows (block-uniform row scales scale the shift consistently)
+    soc = np.asarray(spec.is_soc)
+    np.testing.assert_allclose(np.asarray(b.qp.bl)[:, soc],
+                               np.asarray(b.qp.bu)[:, soc], atol=0.0)
+    # the derived QPs (fixed nonants / W-shifts) inherit the spec
+    xhat = jnp.zeros((b.tree.num_nodes, b.num_nonants), b.qp.c.dtype)
+    assert b.with_fixed_nonants(xhat).cones is spec
+
+
+def test_batch_rejects_mismatched_cone_patterns():
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(3)]
+    broken = dataclasses.replace(
+        specs[1], soc_blocks=[blk + 1 for blk in specs[1].soc_blocks])
+    with pytest.raises(ValueError, match="pattern"):
+        batch_mod.from_specs([specs[0], broken, specs[2]],
+                             tree=ccopf.make_tree((3, 1)))
+
+
+def test_ef_assembly_offsets_cone_blocks():
+    from mpisppy_tpu.algos.ef import build_ef
+
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(3)]
+    efp = build_ef(specs, tree=ccopf.make_tree((3, 1)))
+    spec = efp.qp.cones
+    assert spec is not None and spec.num_cones == 3 * 9
+    m_per = specs[0].A.shape[0]
+    seg = np.asarray(spec.seg)
+    soc = np.asarray(spec.is_soc)
+    # scenario s's blocks live in rows [s*m_per, (s+1)*m_per) and the
+    # trailing nonant link rows carry no cones
+    for s in range(3):
+        blk_ids = np.unique(seg[s * m_per:(s + 1) * m_per][
+            soc[s * m_per:(s + 1) * m_per]])
+        assert blk_ids.min() >= s * 9 and blk_ids.max() < (s + 1) * 9
+    assert not soc[3 * m_per:].any()
+    np.testing.assert_allclose(np.asarray(efp.qp.bl)[soc],
+                               np.asarray(efp.qp.bu)[soc], atol=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas window kernel: conic dual prox via membership-matrix dots
+# ---------------------------------------------------------------------------
+def test_pallas_conic_window_matches_xla():
+    from mpisppy_tpu.ops import pdhg_pallas
+
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(9)]
+    b = batch_mod.from_specs(specs, tree=ccopf.make_tree((3, 3)))
+    qp = b.qp
+    assert pdhg_pallas.supported(qp)
+    opts = pdhg.PDHGOptions(tol=1e-6)
+    st = pdhg.init_state(qp, opts)
+    tau = st.omega / st.Lnorm
+    sigma = 1.0 / (st.omega * st.Lnorm)
+    stt = st
+    xs = jnp.zeros_like(st.x)
+    ys = jnp.zeros_like(st.y)
+    for _ in range(8):
+        stt = pdhg._pdhg_iter(qp, stt, tau, sigma)
+        xs = xs + stt.x
+        ys = ys + stt.y
+    xo, yo, xso, yso = pdhg_pallas.run_window(
+        qp, st.x, st.y, jnp.zeros_like(st.x), jnp.zeros_like(st.y),
+        tau, sigma, jnp.zeros(st.x.shape[0], bool), 8, interpret=True)
+    np.testing.assert_allclose(np.asarray(xo), np.asarray(stt.x),
+                               atol=2e-6)
+    np.testing.assert_allclose(np.asarray(yo), np.asarray(stt.y),
+                               atol=2e-6)
+    np.testing.assert_allclose(np.asarray(xso), np.asarray(xs), atol=5e-6)
+    np.testing.assert_allclose(np.asarray(yso), np.asarray(ys), atol=5e-6)
+
+
+# ---------------------------------------------------------------------------
+# ccopf --soc: the cylinder wheel on the conic workload
+# ---------------------------------------------------------------------------
+def test_ccopf_soc_wheel_end_to_end():
+    """The full hub + Lagrangian + xhat wheel on the branch-flow SOCP
+    relaxation: a certified gap closes, and the published bounds'
+    conic dual-feasibility residual is zero (the certificate the conic
+    Fenchel accounting rests on)."""
+    from mpisppy_tpu.cylinders.spoke import (
+        LagrangianOuterBound, XhatXbarInnerBound,
+    )
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(9)]
+    b = batch_mod.from_specs(specs, tree=ccopf.make_tree((3, 3)))
+    opts = ph_mod.PHOptions(default_rho=10.0, max_iterations=40,
+                            conv_thresh=0.0,
+                            pdhg=pdhg.PDHGOptions(tol=1e-6))
+    hub = {"hub_class": PHHub, "hub_kwargs": {"options": {"rel_gap": 5e-3}},
+           "opt_class": ph_mod.PH,
+           "opt_kwargs": {"options": opts, "batch": b}}
+    spokes = [{"spoke_class": LagrangianOuterBound,
+               "opt_kwargs": {"options": {}}},
+              {"spoke_class": XhatXbarInnerBound,
+               "opt_kwargs": {"options": {}}}]
+    wheel = WheelSpinner(hub, spokes).spin()
+    outer = wheel.BestOuterBound
+    inner = wheel.BestInnerBound
+    assert np.isfinite(outer) and np.isfinite(inner)
+    assert outer <= inner + 1e-6
+    _, rel_gap = wheel.spcomm.compute_gaps()
+    assert rel_gap <= 5e-3
+    # conic dual feasibility of the hub's final subproblem duals: PDHG
+    # iterates never leave the polar cone, so the residual the
+    # certificates fold into rel_dual must be exactly zero here
+    st = wheel.spcomm.opt.state
+    dcr = np.asarray(cones.dual_cone_residual_rows(b.qp.cones,
+                                                   st.solver.y))
+    np.testing.assert_allclose(dcr, 0.0, atol=1e-6)   # 0 up to f32 ulps
+
+
+def test_ccopf_soc_relaxation_is_meaningful():
+    """The SOC blocks actually bind: dropping them (same rows treated
+    as free box rows) must strictly lower the optimum — i.e. the conic
+    constraint is doing work, not decoration."""
+    specs = [ccopf.scenario_creator(nm, soc=True)
+             for nm in ccopf.scenario_names_creator(3)]
+    b = batch_mod.from_specs(specs, tree=ccopf.make_tree((3, 1)))
+    opts = pdhg.PDHGOptions(tol=1e-7, max_iters=40_000)
+    st = pdhg.solve(b.qp, opts, pdhg.init_state(b.qp, opts))
+    obj_soc = float(b.expectation(
+        jnp.sum(b.qp.c * st.x + 0.5 * b.qp.q * st.x * st.x, axis=-1)))
+    # free the SOC rows entirely (bounds to +-inf, no cones)
+    soc = np.asarray(b.qp.cones.is_soc)
+    bl = np.asarray(b.qp.bl).copy()
+    bu = np.asarray(b.qp.bu).copy()
+    bl[:, soc] = -np.inf
+    bu[:, soc] = np.inf
+    qp_free = dataclasses.replace(
+        b.qp, bl=jnp.asarray(bl, b.qp.bl.dtype),
+        bu=jnp.asarray(bu, b.qp.bu.dtype), cones=None)
+    st2 = pdhg.solve(qp_free, opts, pdhg.init_state(qp_free, opts))
+    obj_free = float(b.expectation(
+        jnp.sum(qp_free.c * st2.x + 0.5 * qp_free.q * st2.x * st2.x,
+                axis=-1)))
+    assert obj_free < obj_soc - 1e-3 * max(1.0, abs(obj_soc))

@@ -206,3 +206,63 @@ def test_fused_wheel_checkpoint_resume(tmp_path):
     assert ws2.BestOuterBound >= ob1 - 1e-6
     # trivial bound was not re-folded (Iter0 skipped on resume)
     assert ws2.opt._iter > 12
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r5 regressions
+# ---------------------------------------------------------------------------
+def test_gather_qp_ell_by_field_layout():
+    """_gather_qp must never scenario-gather an EllMatrix's shared cols
+    index array, even when m == S (the tree_map-over-leading-dim
+    heuristic silently corrupted the tail-rescue sub-batch)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.ops import boxqp, sparse
+
+    S = m = 4   # the trap: row count equals scenario count
+    n, k = 3, 2
+    rng = np.random.default_rng(0)
+    cols = jnp.asarray(rng.integers(0, n, size=(m, k)), jnp.int32)
+    vals_b = jnp.asarray(rng.normal(size=(S, m, k)), jnp.float32)
+    qp = boxqp.BoxQP(
+        c=jnp.zeros((S, n), jnp.float32), q=jnp.zeros((S, n), jnp.float32),
+        A=sparse.EllMatrix(vals=vals_b, cols=cols, n=n),
+        bl=jnp.zeros((S, m), jnp.float32), bu=jnp.ones((S, m), jnp.float32),
+        l=jnp.zeros((S, n), jnp.float32), u=jnp.ones((S, n), jnp.float32))
+    idx = jnp.asarray([2, 0])
+    sub = fw._gather_qp(qp, idx, S)
+    np.testing.assert_array_equal(np.asarray(sub.A.cols), np.asarray(cols))
+    np.testing.assert_array_equal(np.asarray(sub.A.vals),
+                                  np.asarray(vals_b)[np.asarray(idx)])
+    # a SHARED vals (m, k) — leading dim S-sized — must stay shared too
+    qp2 = dataclasses.replace(
+        qp, A=sparse.EllMatrix(vals=vals_b[0], cols=cols, n=n))
+    sub2 = fw._gather_qp(qp2, idx, S)
+    assert sub2.A.vals.ndim == 2
+    np.testing.assert_array_equal(np.asarray(sub2.A.vals),
+                                  np.asarray(vals_b)[0])
+
+
+def test_scalar_pipeline_depth_shared_constant():
+    """The scalar-cache pipeline depth is a single named constant and
+    the split-dispatch freshness check reads it (hard-coding the depth
+    in two places misattributes landed/dead flags when one changes)."""
+    import inspect
+
+    assert fw.SCALAR_PIPELINE_DEPTH == 2
+    assert "SCALAR_PIPELINE_DEPTH" in inspect.getsource(
+        fw.FusedPH._iterk_split)
+
+
+def test_eval_step_comp_is_safety_scaled():
+    """The fused planes' published inner values carry the SAFETY-SCALED
+    first-order compensation (approximately-certified semantics — see
+    xhat.COMP_SAFETY)."""
+    import inspect
+
+    from mpisppy_tpu.algos import xhat as xhat_mod
+
+    assert xhat_mod.COMP_SAFETY >= 2.0
+    assert "COMP_SAFETY" in inspect.getsource(fw._eval_step)

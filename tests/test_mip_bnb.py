@@ -295,3 +295,26 @@ def test_dive_multistart_and_lns_shapes(small_sslp_batch):
         rv, rx, rf = rep
         # never a regression
         assert bool(jnp.all(jnp.where(feas, rv <= val + 1e-6, True)))
+
+
+def test_swap_rounds_default_off_and_polish_enables():
+    """ADVICE r5: the dual-guided SOS1 swap repair defaults OFF (the
+    hot Lagrangian-oracle loops were paying ~50 warm re-solves per
+    solve_mip) and the polish entry points enable it explicitly."""
+    from mpisppy_tpu.algos import mip
+
+    assert BnBOptions().swap_rounds == 0
+    assert bnb.POLISH_SWAP_ROUNDS == 24
+    # the polish resolution rule: 0 = auto promotes to the polish
+    # budget; explicit caller values (tuned-down positive, force-off
+    # negative) are honored verbatim
+    assert mip._polish_swap(BnBOptions()).swap_rounds \
+        == bnb.POLISH_SWAP_ROUNDS
+    assert mip._polish_swap(BnBOptions(swap_rounds=8)).swap_rounds == 8
+    assert mip._polish_swap(BnBOptions(swap_rounds=-1)).swap_rounds == -1
+    # at the default budget the repair is a guaranteed no-op (the hot
+    # path pays nothing before the early return)
+    assert bnb.sos1_swap_repair(None, None, None, None, None,
+                                BnBOptions()) is None
+    assert bnb.sos1_swap_repair(None, None, None, None, None,
+                                BnBOptions(swap_rounds=-1)) is None
