@@ -31,6 +31,21 @@ from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator
 from mpisppy_tpu.cylinders.spoke import ConvergerSpokeType
 
 
+def _checkpoint_crc(data: dict) -> np.ndarray:
+    """CRC32 over every array in key order — the checkpoint integrity
+    stamp (docs/resilience.md).  Deterministic: keys sorted, raw bytes.
+    Zero-copy: crc32 reads the array buffers directly (tobytes() would
+    duplicate the full ~460 MB snapshot inside the time-critical
+    emergency-save path)."""
+    import zlib
+    crc = 0
+    for k in sorted(data):
+        crc = zlib.crc32(k.encode(), crc)
+        arr = np.ascontiguousarray(data[k])
+        crc = zlib.crc32(memoryview(arr).cast("B"), crc)
+    return np.asarray(crc, np.uint32)
+
+
 class Hub(SPCommunicator):
     """Bound bookkeeping + termination (ref:cylinders/hub.py:28-243)."""
 
@@ -44,20 +59,57 @@ class Hub(SPCommunicator):
         self._inner_bound_update_iter = 0
         self._iter = 0
         self.trace: list[dict] = []
+        # sense-contradiction bookkeeping (docs/resilience.md): a
+        # rejected bound is ambiguous evidence — EITHER the incoming
+        # value or the standing opposite-sense incumbent is garbage.
+        # _contra[side] records the DISTINCT spokes whose bounds
+        # contradicted the CURRENT incumbent of `side`; enough of them
+        # evict it (see _note_contradiction).
+        self._contra: dict[str, list] = {"outer": [], "inner": []}
 
     # -- bound bookkeeping (ref:hub.py:207-243) ---------------------------
+    # Non-finite values never enter the bookkeeping: a NaN outer bound
+    # would poison every later max() comparison silently, and a +inf
+    # outer (or -inf inner) would fire gap termination on garbage.
+    # Sense CROSS-validation (outer vs inner) lives in _harvest_all where
+    # the per-spoke strike counters are (docs/resilience.md).
     def OuterBoundUpdate(self, new_bound: float, char: str = "*"):
-        if new_bound > self.BestOuterBound:
+        if math.isfinite(new_bound) and new_bound > self.BestOuterBound:
             self.BestOuterBound = new_bound
             self.latest_ob_char = char
         return self.BestOuterBound
 
     def InnerBoundUpdate(self, new_bound: float, char: str = "*"):
-        if new_bound < self.BestInnerBound:
+        if math.isfinite(new_bound) and new_bound < self.BestInnerBound:
             self.BestInnerBound = new_bound
             self.latest_ib_char = char
             self._inner_bound_update_iter = self._iter
         return self.BestInnerBound
+
+    def _validate_bound(self, sense: str, b: float) -> str | None:
+        """None when `b` is acceptable, else a rejection reason.
+
+        A bound is rejected when non-finite or SENSE-VIOLATING: an
+        outer (lower) bound above the incumbent, or an inner bound
+        below the certified outer bound, by more than `bound_slack`
+        relative (default 5e-3 — legitimate f32 crossings measured up
+        to ~2e-3 on the farmer wheel must pass)."""
+        if not math.isfinite(b):
+            return f"non-finite {sense} bound {b!r}"
+        slack = float(self.options.get("bound_slack", 5e-3))
+        if sense == "outer" and math.isfinite(self.BestInnerBound):
+            lim = self.BestInnerBound \
+                + slack * max(1.0, abs(self.BestInnerBound))
+            if b > lim:
+                return (f"sense-violating outer bound {b:.6g} > "
+                        f"inner {self.BestInnerBound:.6g} + slack")
+        if sense == "inner" and math.isfinite(self.BestOuterBound):
+            lim = self.BestOuterBound \
+                - slack * max(1.0, abs(self.BestOuterBound))
+            if b < lim:
+                return (f"sense-violating inner bound {b:.6g} < "
+                        f"outer {self.BestOuterBound:.6g} - slack")
+        return None
 
     # -- gaps + termination (ref:hub.py:82-166) ---------------------------
     def compute_gaps(self) -> tuple[float, float]:
@@ -146,20 +198,149 @@ class PHHub(Hub):
         }
 
     def _harvest_all(self, only=None):
-        """Fold every spoke's latest result into the bound bookkeeping."""
-        for sp in (self.spokes if only is None else only):
+        """Fold every spoke's latest result into the bound bookkeeping.
+
+        Harvested bounds are VALIDATED before they can move
+        BestOuterBound/BestInnerBound.  Non-finite values (unambiguous
+        garbage) count a strike against the producing spoke; after
+        `spoke_max_strikes` the spoke is auto-disabled (skipped by
+        harvest AND update) and the wheel continues on the remaining
+        spokes — the analog of the reference simply not reading a dead
+        cylinder's window.  Sense-violating values are rejected without
+        blame and recorded as contradictions against the standing
+        opposite incumbent (_note_contradiction).  The optional
+        options['fault_plan'] harvest seam injects poisoned bounds
+        HERE, between the spoke and the validation (resilience/faults)."""
+        plan = self.options.get("fault_plan")
+        max_strikes = int(self.options.get("spoke_max_strikes", 3))
+        for j, sp in enumerate(self.spokes):
+            if only is not None and sp not in only:
+                continue
+            if getattr(sp, "disabled", False):
+                continue
             b = sp.harvest()
             if b is None:
+                continue
+            types = sp.converger_spoke_types
+            if ConvergerSpokeType.OUTER_BOUND in types:
+                sense = "outer"
+            elif ConvergerSpokeType.INNER_BOUND in types:
+                sense = "inner"
+            else:
+                continue  # cut/rc providers publish no bound
+            if plan is not None:
+                b = plan.filter_bound(j, sense, float(b), self._iter)
+            reason = self._validate_bound(sense, b)
+            if reason is not None:
+                # scrub the offending value from the spoke's monotone
+                # cache: harvests re-return the cache even with no new
+                # result, so one transient spike would otherwise
+                # re-offer itself every sync forever
+                if getattr(sp, "bound", None) is not None:
+                    sp.bound = None
+                if reason.startswith("sense-violating"):
+                    # ambiguous evidence (either the incoming value or
+                    # the standing opposite incumbent is garbage):
+                    # never a strike — blame needs corroboration
+                    self._note_contradiction(sense, sp, reason)
+                else:
+                    self._strike(j, sp, reason, max_strikes)
                 continue
             # spokes may declare their trace char (ref spoke classes'
             # converger_spoke_char); default to the class initial
             ch = getattr(sp, "converger_spoke_char",
                          type(sp).__name__[0])
-            if ConvergerSpokeType.OUTER_BOUND in sp.converger_spoke_types:
+            if sense == "outer":
                 self.OuterBoundUpdate(b, ch)
-            elif ConvergerSpokeType.INNER_BOUND in sp.converger_spoke_types:
+            else:
+                before = self.BestInnerBound
                 self.InnerBoundUpdate(b, ch)
+                # hub-side incumbent cache: BestInnerBound must always
+                # have a backing solution, even after the producing
+                # spoke's cache is later scrubbed or the spoke disabled
+                # (best_nonants falls back to this before xbar)
+                if (self.BestInnerBound < before
+                        and getattr(sp, "best_xhat", None) is not None):
+                    self._best_inner_xhat = np.asarray(sp.best_xhat)
+            # an accepted bound is CONSISTENT with the opposite-sense
+            # incumbent: clear the suspicion that had built against it
+            other = "inner" if sense == "outer" else "outer"
+            self._contra[other] = []
             sp.trace.append((self._iter, b))
+
+    def _strike(self, j: int, sp, reason: str, max_strikes: int):
+        """One unambiguously-garbage (non-finite) bound = one strike; K
+        strikes disable the spoke (ref analog: a misbehaving cylinder's
+        window is never read again).  Counters survive on the spoke
+        object so finalize() and tests can inspect them.  Only fresh
+        invalid results accumulate strikes — the caller scrubs rejected
+        values from the spoke cache, and the hub's own Best*Bound keeps
+        every previously accepted value."""
+        sp.strikes = getattr(sp, "strikes", 0) + 1
+        global_toc(f"hub: rejected bound from spoke {j} "
+                   f"({type(sp).__name__}): {reason} "
+                   f"[strike {sp.strikes}/{max_strikes}]",
+                   self.options.get("display_progress", False))
+        if sp.strikes >= max_strikes and not getattr(sp, "disabled",
+                                                     False):
+            sp.disabled = True
+            global_toc(f"hub: DISABLED spoke {j} ({type(sp).__name__}) "
+                       f"after {sp.strikes} strikes; continuing with "
+                       f"the remaining spokes", True)
+
+    def _note_contradiction(self, sense: str, sp, reason: str):
+        """A finite sense-violating bound is ambiguous: EITHER the
+        incoming value or the standing opposite incumbent is garbage —
+        e.g. a wrong-sense outer bound accepted at iter 1 (before any
+        inner existed to validate against) would poison the monotone
+        BestOuterBound forever.  Contradictions from enough DISTINCT
+        spokes flip the verdict and evict the incumbent.  Distinctness
+        matters: one persistently rogue spoke repeating garbage every
+        sync must never out-vote a repeatedly-confirmed incumbent (a
+        count-based trigger let exactly that happen), so a lone
+        contradictor can only ever log its dissent — in a two-spoke
+        wheel a poisoned early incumbent stands, the wheel honestly
+        never certifies, and the report shows the missing side as null
+        rather than lying."""
+        global_toc(f"hub: rejected {reason}",
+                   self.options.get("display_progress", False))
+        other = "outer" if sense == "inner" else "inner"
+        rec = self._contra[other]
+        if sp not in rec:
+            rec.append(sp)
+        limit = int(self.options.get("bound_evict_contras", 3))
+        if len(rec) >= limit:
+            self._evict_incumbent(other, rec)
+
+    def _evict_incumbent(self, side: str, contradictors: list):
+        """Reset a contradicted incumbent — no strikes, no blame: the
+        evidence stays ambiguous, so nothing is charged to anyone and
+        the surviving producers simply re-establish the bound on the
+        next exchange."""
+        val = self.BestOuterBound if side == "outer" \
+            else self.BestInnerBound
+        global_toc(f"hub: EVICTING the {side} incumbent ({val:.6g}) — "
+                   f"contradicted by {len(contradictors)} distinct "
+                   f"spokes", True)
+        if side == "outer":
+            self.BestOuterBound = -math.inf
+            self.latest_ob_char = ""
+            # re-fold the hub's own certified trivial bound: it never
+            # came from a spoke and is the one outer value we trust
+            if (getattr(self, "_trivial_bound_folded", False)
+                    and getattr(self.opt, "trivial_bound_certified",
+                                False)
+                    and self.opt.trivial_bound is not None):
+                self.OuterBoundUpdate(self.opt.trivial_bound, "T")
+        else:
+            self.BestInnerBound = math.inf
+            self.latest_ib_char = ""
+            # the solution backing the evicted (distrusted) incumbent
+            # goes with it — best_nonants must never write it out
+            self._best_inner_xhat = None
+            # don't let the eviction read as an instant stall
+            self._inner_bound_update_iter = self._iter
+        self._contra[side] = []
 
     def _fold_own_bounds(self):
         """Fold bounds the hub algorithm itself produces (PH: none —
@@ -179,6 +360,14 @@ class PHHub(Hub):
         slower-cylinder overlap (ref:hub.py write-id freshness checks —
         a spoke that hasn't produced a new result simply isn't read)."""
         self._iter += 1
+        plan = self.options.get("fault_plan")
+        if plan is not None:
+            # chaos seams (resilience/faults): a simulated preemption
+            # unwinds to WheelSpinner.spin's emergency save; lane
+            # corruption mutates the solver state host-side so the
+            # pdhg lane guard has something real to catch
+            plan.maybe_preempt(self._iter)
+            plan.corrupt_lanes(self._iter, self.opt)
         period = max(1, int(self.options.get("spoke_sync_period", 1)))
         do_spokes = (self._iter <= 2) or (self._iter % period == 0)
         # fused spokes (algos.fused_wheel) compute inside the hub's own
@@ -204,7 +393,8 @@ class PHHub(Hub):
             self.from_hub.put(payload)  # for API parity / inspection
             if do_spokes:
                 for sp in classic:
-                    sp.update(payload)
+                    if not getattr(sp, "disabled", False):
+                        sp.update(payload)
         self._maybe_checkpoint()
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
@@ -240,10 +430,15 @@ class PHHub(Hub):
             return
         if now - last < every:
             return
-        self._last_ckpt_t = now
-        self.save_checkpoint(path, background=True)
+        # only consume the cadence slot when a save actually LAUNCHES:
+        # a skipped save (previous write thread still alive) must retry
+        # next sync, or a slow write silently halves the checkpoint
+        # frequency
+        if self.save_checkpoint(path, background=True):
+            self._last_ckpt_t = now
 
-    def save_checkpoint(self, path: str, background: bool = False):
+    def save_checkpoint(self, path: str, background: bool = False,
+                        tmp_tag: str = ".tmp"):
         """Atomic npz snapshot of the full wheel: solver state (wstate
         for FusedPH, else PHState), hub bound bookkeeping, spoke bests,
         and caller extras (options['checkpoint_extra'] -> dict).
@@ -253,8 +448,11 @@ class PHHub(Hub):
         the device tunnel synchronously (~50 s measured) would gate the
         hub loop.  The state pytree is immutable and device_get is
         thread-safe, so the transfer overlaps compute; at most one save
-        is in flight (later requests are skipped, not queued)."""
-        import os
+        is in flight (later requests are skipped, not queued).
+
+        Returns True when a write launched (or completed, for
+        synchronous saves), False when it was skipped — the cadence
+        bookkeeping in _maybe_checkpoint depends on this."""
         import threading
 
         import jax
@@ -262,19 +460,43 @@ class PHHub(Hub):
         which = "wstate" if st is not None else "state"
         if st is None:
             st = self.opt.state
+        if st is None:
+            return False  # preempted before Iter0: nothing to persist
+        # created here (always the main thread) so the two possible
+        # writers — the background daemon and a later emergency save —
+        # share one lock without a creation race
+        if not hasattr(self, "_ckpt_lock"):
+            self._ckpt_lock = threading.Lock()
         leaves, _ = jax.tree.flatten(st)
         if background:
             prev = getattr(self, "_ckpt_thread", None)
             if prev is not None and prev.is_alive():
-                return
+                return False
             host_meta = self._checkpoint_meta(which)
             t = threading.Thread(
                 target=self._write_checkpoint,
-                args=(path, leaves, host_meta), daemon=True)
+                args=(path, leaves, host_meta, tmp_tag), daemon=True)
             self._ckpt_thread = t
             t.start()
-            return
-        self._write_checkpoint(path, leaves, self._checkpoint_meta(which))
+            return True
+        self._write_checkpoint(path, leaves, self._checkpoint_meta(which),
+                               tmp_tag)
+        return True
+
+    def emergency_checkpoint(self, path: str) -> bool:
+        """Synchronous last-gasp save for SIGTERM/SIGINT/preemption.
+
+        Deliberately does NOT wait for an in-flight background write: at
+        10k scenarios a snapshot write is ~50 s (see save_checkpoint),
+        longer than the eviction grace window, so joining would forfeit
+        the save.  A distinct tmp name keeps the two writers from
+        clobbering each other's staging file; if the slow background
+        write lands after us its (older) snapshot becomes `path` and
+        ours rotates to path.1 — load_checkpoint validates and falls
+        back, so a complete snapshot survives either ordering.  Returns
+        True when a snapshot landed."""
+        return self.save_checkpoint(path, background=False,
+                                    tmp_tag=".emergency.tmp")
 
     def _checkpoint_meta(self, which: str) -> dict:
         """Host-side bookkeeping captured SYNCHRONOUSLY (the mutable
@@ -297,37 +519,136 @@ class PHHub(Hub):
                 bx = getattr(sp, "best_xhat", None)
                 if bx is not None:
                     data[f"spoke{j}_xhat"] = np.asarray(bx)
+        bx = getattr(self, "_best_inner_xhat", None)
+        if bx is not None:
+            data["hub_best_xhat"] = np.asarray(bx)
         extra = self.options.get("checkpoint_extra")
         if callable(extra):
             for k, v in extra().items():
                 data[f"extra_{k}"] = np.asarray(v)
         return data
 
-    def _write_checkpoint(self, path: str, leaves, data: dict):
+    def _write_checkpoint(self, path: str, leaves, data: dict,
+                          tmp_tag: str = ".tmp"):
+        """Atomic rotated write: tmp -> rotate path->path.1->... ->
+        rename tmp to path.  The meta carries a CRC32 over every array
+        so load_checkpoint can reject silent corruption (a torn zip
+        already fails np.load; bit rot inside a member does not)."""
         import os
         for i, x in enumerate(leaves):
             data[f"leaf{i}"] = np.asarray(x)
-        tmp = path + ".tmp"
+        data["crc"] = _checkpoint_crc(data)
+        tmp = path + tmp_tag
         with open(tmp, "wb") as f:
             np.savez(f, **data)
-        os.replace(tmp, path)
+        # rotate + final rename under the shared writer lock: without
+        # it the background daemon could rename its OLDER tmp over a
+        # just-landed emergency snapshot without rotating it aside,
+        # destroying the newest state outright (distinct tmp names only
+        # protect the staging files, not this sequence)
+        import threading
+        lock = getattr(self, "_ckpt_lock", None) or threading.Lock()
+        with lock:
+            # keep floor of 2: with a single slot a slow background
+            # write finishing after an emergency save would still
+            # CLOBBER it — the both-orderings survival guarantee
+            # (emergency_checkpoint) needs >= 2 slots
+            keep = max(2, int(self.options.get("checkpoint_keep", 2)))
+            for i in range(keep - 1, 0, -1):
+                src = path if i == 1 else f"{path}.{i - 1}"
+                try:
+                    if os.path.exists(src):
+                        os.replace(src, f"{path}.{i}")
+                except OSError:
+                    # a stolen rotation slot is harmless — every
+                    # completed snapshot is self-validating; only
+                    # losing a WRITE would matter
+                    pass
+            os.replace(tmp, path)
+        plan = self.options.get("fault_plan")
+        if plan is not None:
+            plan.on_checkpoint_written(path)
+
+    def _checkpoint_candidates(self, path: str) -> list[str]:
+        """Existing snapshots, newest first: path, path.1, path.2, ..."""
+        import os
+        out = [path] if os.path.exists(path) else []
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            out.append(f"{path}.{i}")
+            i += 1
+        return out
 
     def load_checkpoint(self, path: str) -> dict:
         """Restore a save_checkpoint snapshot into the built (unspun)
         wheel; ph_main then skips Iter0 and resumes the loop.  Returns
-        the extras dict."""
+        the extras dict.
+
+        Falls back through the rotated candidates (path, path.1, ...)
+        on a torn/corrupt/incompatible file instead of crashing — the
+        preemption-tolerance contract: the newest VALID snapshot wins.
+        "Newest" is decided by the hub_iter stored in each snapshot's
+        meta, not by filename: an emergency save racing a slow
+        background write can leave the OLDER snapshot at `path` (the
+        background writer's rotation lands last), and filename order
+        would silently discard the iterations the emergency save
+        preserved."""
+        cands = self._checkpoint_candidates(path)
+        order = []
+        for i, cand in enumerate(cands):
+            try:  # cheap lazy read of one meta scalar, no validation
+                with np.load(cand) as d:
+                    it = int(d["hub_iter"])
+            except Exception:
+                it = -1  # unreadable here: full validation gets it last
+            order.append((it, -i, cand))
+        order.sort(reverse=True)
+        errors = []
+        for _, _, cand in order:
+            try:
+                arrays = self._read_checkpoint_arrays(cand)
+            except Exception as e:  # torn zip, bad crc, IO error, ...
+                errors.append(f"{cand}: {type(e).__name__}: {e}")
+                continue
+            try:
+                extras = self._restore_from_arrays(arrays)
+            except ValueError as e:  # wrong shapes/dtypes/leaf count
+                errors.append(f"{cand}: {e}")
+                continue
+            if cand != path:
+                global_toc(f"checkpoint: {path} invalid, restored the "
+                           f"older rotated snapshot {cand}", True)
+            return extras
+        detail = "; ".join(errors) if errors else "no snapshot files"
+        raise FileNotFoundError(
+            f"no valid checkpoint under {path!r}: {detail}")
+
+    def _read_checkpoint_arrays(self, path: str) -> dict:
+        """Load + integrity-check one snapshot file (no state mutation).
+        The NpzFile is a context manager — it holds an open zip handle
+        that was previously never closed."""
+        with np.load(path) as data:
+            arrays = {k: np.asarray(data[k]) for k in data.files}
+        if "crc" in arrays:
+            stored = int(arrays.pop("crc"))
+            actual = int(_checkpoint_crc(arrays))
+            if actual != stored:
+                raise ValueError(
+                    f"checksum mismatch (stored {stored:#x}, "
+                    f"recomputed {actual:#x})")
+        if "which" not in arrays:
+            raise ValueError("not a wheel checkpoint (missing 'which')")
+        return arrays
+
+    def _restore_from_arrays(self, data: dict) -> dict:
         import jax
         import jax.numpy as jnp
-        data = np.load(path)
+        from mpisppy_tpu.utils.wxbarutils import validate_state_leaves
         which = bytes(data["which"]).decode()
         template = self.opt.state_template()
         leaves, treedef = jax.tree.flatten(template)
+        validate_state_leaves(data, leaves)
         new = [jnp.asarray(data[f"leaf{i}"]) for i in range(len(leaves))]
-        for i, (a, b) in enumerate(zip(new, leaves)):
-            if tuple(a.shape) != tuple(b.shape):
-                raise ValueError(
-                    f"checkpoint leaf {i} shape {a.shape} != expected "
-                    f"{b.shape} (different problem/options?)")
         st = jax.tree.unflatten(treedef, new)
         if which == "wstate":
             self.opt.wstate = st
@@ -343,13 +664,15 @@ class PHHub(Hub):
         self.opt.trivial_bound = None if math.isnan(tb) else tb
         self.opt.trivial_bound_certified = bool(cert)
         self._trivial_bound_folded = bool(folded)
+        if "hub_best_xhat" in data:
+            self._best_inner_xhat = np.asarray(data["hub_best_xhat"])
         for j, sp in enumerate(self.spokes):
             key = f"spoke{j}_bound"
             if key in data:
                 sp.bound = float(data[key])
                 if f"spoke{j}_xhat" in data:
                     sp.best_xhat = np.asarray(data[f"spoke{j}_xhat"])
-        return {k[len("extra_"):]: data[k] for k in data.files
+        return {k[len("extra_"):]: data[k] for k in data
                 if k.startswith("extra_")}
 
     def is_converged(self) -> bool:
@@ -398,12 +721,29 @@ class PHHub(Hub):
         falls back to the final xbar when no incumbent exists."""
         winner, best = None, math.inf
         for sp in self.spokes:
+            # a NaN cached bound must never enter the winner scan (every
+            # NaN comparison is False, so depending on spoke order it
+            # could silently shadow — or be shadowed by — a real
+            # incumbent), and neither may a disabled spoke's cache or a
+            # value the hub's validation would reject: the written
+            # solution must be consistent with the reported bounds
             if (ConvergerSpokeType.INNER_BOUND in sp.converger_spoke_types
-                    and sp.bound is not None and sp.bound < best
+                    and not getattr(sp, "disabled", False)
+                    and sp.bound is not None and math.isfinite(sp.bound)
+                    and sp.bound < best
+                    and self._validate_bound("inner", sp.bound) is None
                     and getattr(sp, "best_xhat", None) is not None):
                 winner, best = sp, sp.bound
+        xhat = None
         if winner is not None:
             xhat = np.asarray(winner.best_xhat)
+        elif getattr(self, "_best_inner_xhat", None) is not None:
+            # the spoke that produced BestInnerBound was scrubbed or
+            # disabled since: the hub-side cache (stored the moment the
+            # bound was ACCEPTED, _harvest_all) still backs the
+            # reported bound with its actual solution
+            xhat = self._best_inner_xhat
+        if xhat is not None:
             if xhat.ndim == 1:
                 num_nodes = self.opt.batch.tree.num_nodes
                 return np.broadcast_to(xhat, (num_nodes, xhat.shape[0]))

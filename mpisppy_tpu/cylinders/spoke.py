@@ -22,6 +22,7 @@
 from __future__ import annotations
 
 import enum
+import math
 from functools import partial
 
 import jax
@@ -56,6 +57,11 @@ class Spoke(SPCommunicator):
         self.bound: float | None = None
         self._pending = None  # un-read device results (async dispatch)
         self.trace: list[tuple[int, float]] = []  # (hub_iter, bound)
+        # resilience bookkeeping (docs/resilience.md): the hub counts a
+        # strike per rejected bound and flips `disabled` after K — a
+        # disabled spoke is neither updated nor harvested again
+        self.strikes = 0
+        self.disabled = False
 
     def update(self, hub_payload: dict):
         """Launch this spoke's computation for the hub snapshot.  Must
@@ -85,7 +91,12 @@ class OuterBoundSpoke(Spoke):
         res = self._pending
         if bool(res.certified):
             b = float(res.bound)
-            if self.bound is None or b > self.bound:
+            # a non-finite bound must not become the cached best: every
+            # later `b > NaN` comparison is False, so one poisoned solve
+            # would pin the spoke at NaN forever (quarantine-at-source;
+            # the hub additionally validates + strikes, hub.py)
+            if math.isfinite(b) and (self.bound is None
+                                     or b > self.bound):
                 self.bound = b
         return self.bound
 
@@ -112,6 +123,8 @@ class InnerBoundSpoke(Spoke):
             "comp_tol", xhat_mod.DEFAULT_COMP_TOL))
 
     def _offer(self, value: float, xhat) -> None:
+        if not math.isfinite(value):
+            return  # never cache a poisoned incumbent (see OuterBound)
         if self.bound is None or value < self.bound:
             self.bound = value
             self.best_xhat = np.asarray(xhat)
@@ -157,7 +170,9 @@ class FusedLagrangianOuterBound(OuterBoundSpoke):
             return self.bound
         if sc["lag_certified"] > 0.5:
             b = sc["lag_bound"]
-            if self.bound is None or b > self.bound:
+            # same non-finite cache refusal as OuterBoundSpoke.harvest
+            if math.isfinite(b) and (self.bound is None
+                                     or b > self.bound):
                 self.bound = b
         return self.bound
 

@@ -20,6 +20,7 @@ import sys
 
 from mpisppy_tpu import global_toc
 from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.resilience.faults import PreemptionError
 from mpisppy_tpu.spin_the_wheel import WheelSpinner
 from mpisppy_tpu.utils import cfg_vanilla as vanilla
 from mpisppy_tpu.utils.config import Config
@@ -59,6 +60,7 @@ def _parse_args(module, args=None):
     cfg.lshaped_args()
     cfg.converger_args()
     cfg.presolve_args()
+    cfg.resilience_args()
     cfg.wxbar_read_write_args()
     cfg.proper_bundle_config()
     cfg.multistage()
@@ -191,7 +193,15 @@ def _fuse_wheel(cfg, hub, spokes, specs=None, tree=None):
                                "opt_kwargs": {"options": {}}})
         else:
             out_spokes.append(sd)
+    # --lane-guard must reach the fused planes' PDHG options too, or
+    # the CLI knob would silently guard only the hub's subproblems
+    import dataclasses as _dc
+    _defaults = fw.FusedWheelOptions()
+    _guard = {"lane_guard": bool(cfg.get("lane_guard", False)),
+              "guard_max_resets": int(cfg.get("guard_max_resets", 3))}
     wopts = fw.FusedWheelOptions(
+        lag_pdhg=_dc.replace(_defaults.lag_pdhg, **_guard),
+        xhat_pdhg=_dc.replace(_defaults.xhat_pdhg, **_guard),
         lag_windows=8 if spoke_mod.LagrangianOuterBound in present else 0,
         xhat_windows=4 if spoke_mod.XhatXbarInnerBound in present else 0,
         slam_windows=2 if (spoke_mod.SlamMaxHeuristic in present
@@ -327,7 +337,33 @@ def _do_decomp(cfg, module):
                                   tree=batch.tree)
 
     wheel = WheelSpinner(hub, spokes)
-    wheel.spin()
+    ckpt = cfg.get("checkpoint_path")
+    if ckpt and cfg.get("checkpoint_restore"):
+        wheel.build()
+        if wheel.spcomm._checkpoint_candidates(ckpt):
+            try:
+                wheel.spcomm.load_checkpoint(ckpt)
+                global_toc(f"restored checkpoint {ckpt} at hub iter "
+                           f"{wheel.spcomm._iter}; resuming", True)
+            except FileNotFoundError as e:
+                # snapshots exist but NONE validates (bit rot, torn on
+                # a non-atomic fs): a crash here would restart-storm
+                # the pool scheduler against the same dead files —
+                # degrade to a fresh run instead, loudly
+                global_toc(f"WARNING: no valid checkpoint to restore "
+                           f"({e}); starting fresh", True)
+    try:
+        wheel.spin()
+    except PreemptionError as e:
+        # state was already emergency-saved by WheelSpinner.spin; report
+        # and exit with EX_TEMPFAIL so the pool scheduler restarts us
+        # (--checkpoint-restore picks the run back up)
+        global_toc(f"run preempted ({e}); restart with "
+                   f"--checkpoint-restore to resume", True)
+        print(json.dumps({"preempted": True,
+                          "checkpoint_path": ckpt,
+                          "iterations": wheel.spcomm._iter}))
+        raise SystemExit(75)
     abs_gap, rel_gap = wheel.spcomm.compute_gaps()
     global_toc(
         f"outer {wheel.BestOuterBound:.6g} inner {wheel.BestInnerBound:.6g}"

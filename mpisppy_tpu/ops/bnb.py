@@ -221,7 +221,8 @@ def _solve_node(qp_node: BoxQP, x_warm: Array, y_warm: Array,
             k=jnp.zeros((), jnp.int32), nwin=jnp.zeros(bs, jnp.int32),
             restart_score=jnp.full(bs, jnp.inf, dt),
             score=jnp.full(bs, jnp.inf, dt),
-            done=jnp.zeros(bs, bool), status=jnp.zeros(bs, jnp.int32))
+            done=jnp.zeros(bs, bool), status=jnp.zeros(bs, jnp.int32),
+            guard_resets=jnp.zeros(bs, jnp.int32))
     sol = pdhg.solve(qp_solve, lp, st0)
     obj = jnp.sum(qp_node.c * sol.x + 0.5 * qp_node.q * sol.x * sol.x,
                   axis=-1)

@@ -96,6 +96,18 @@ class PDHGOptions:
     # reach ~1e-6 relative KKT on sslp-family LPs when scoring stays
     # exact.  See ops/boxqp.py MATVEC_PRECISION.
     iter_precision: str | None = None
+    # Per-lane divergence guard (resilience subsystem, docs/resilience.md):
+    # at each restart boundary, lanes whose iterates are non-finite or
+    # exceed guard_threshold in magnitude are QUARANTINE-RESET — primal
+    # re-clipped from zero, dual zeroed, window sums/anchors cleared,
+    # omega halved (a too-aggressive primal weight is the usual
+    # divergence driver) — at most guard_max_resets times per lane,
+    # after which the lane is frozen `done` with status RUNNING so it
+    # can never certify a bound.  Healthy lanes are untouched and a
+    # False flag compiles the exact pre-guard program.
+    lane_guard: bool = False
+    guard_threshold: float = 1e12
+    guard_max_resets: int = 3
 
 
 @partial(
@@ -103,7 +115,7 @@ class PDHGOptions:
     data_fields=[
         "x", "y", "x_sum", "y_sum", "x_anchor", "y_anchor",
         "omega", "Lnorm", "k", "nwin", "restart_score", "score", "done",
-        "status",
+        "status", "guard_resets",
     ],
     meta_fields=[],
 )
@@ -123,6 +135,7 @@ class PDHGState:
     score: Array    # (...,) last max relative KKT residual
     done: Array     # (...,) bool
     status: Array   # (...,) int32 RUNNING/OPTIMAL/INFEASIBLE/UNBOUNDED
+    guard_resets: Array   # (...,) int32 cumulative lane-guard quarantines
 
 
 def _bshape(p: BoxQP):
@@ -183,6 +196,7 @@ def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
         score=jnp.full(bs, jnp.inf, dt),
         done=jnp.zeros(bs, bool),
         status=jnp.zeros(bs, jnp.int32),
+        guard_resets=jnp.zeros(bs, jnp.int32),
     )
 
 
@@ -308,6 +322,51 @@ def _restart(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     )
 
 
+def _lane_guard(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
+    """Quarantine-reset diverged lanes (resilience subsystem).
+
+    A lane (batch element) is DIVERGED when its iterates are non-finite
+    or exceed guard_threshold in magnitude — the signature of a badly
+    conditioned scenario, a poisoned warm start, or an omega runaway.
+    Such a lane never converges on its own (NaN propagates; the done
+    mask keeps the rest of the batch correct but the while_loop burns
+    max_iters on the dead lane), so the guard re-initializes ONLY the
+    bad lanes from scratch with halved omega, up to guard_max_resets
+    times; past the budget the lane is frozen `done` with status
+    RUNNING, which no certificate path ever accepts — the batch
+    completes and the wheel degrades gracefully instead of stalling.
+    Counters are surfaced in PDHGState.guard_resets (cumulative)."""
+    mag = jnp.maximum(jnp.max(jnp.abs(st.x), axis=-1),
+                      jnp.max(jnp.abs(st.y), axis=-1))
+    bad = ~st.done & (~jnp.isfinite(mag) | (mag > opts.guard_threshold))
+    give_up = bad & (st.guard_resets >= opts.guard_max_resets)
+    # EVERY bad lane gets its iterates scrubbed — a frozen lane's x
+    # feeds downstream consumers (PH's xbar/W node averages have no
+    # NaN masking), so give-up must freeze a CLEAN unconverged point,
+    # never the poisoned one
+    rx = bad[..., None]
+    x0 = jnp.clip(jnp.zeros_like(st.x), p.l, p.u)
+    return dataclasses.replace(
+        st,
+        x=jnp.where(rx, x0, st.x),
+        y=jnp.where(rx, 0.0, st.y),
+        x_sum=jnp.where(rx, 0.0, st.x_sum),
+        y_sum=jnp.where(rx, 0.0, st.y_sum),
+        x_anchor=jnp.where(rx, x0, st.x_anchor),
+        y_anchor=jnp.where(rx, 0.0, st.y_anchor),
+        omega=jnp.where(bad,
+                        jnp.maximum(jnp.where(jnp.isfinite(st.omega),
+                                              0.5 * st.omega, opts.omega0),
+                                    opts.omega_min),
+                        st.omega),
+        nwin=jnp.where(bad, 0, st.nwin),
+        restart_score=jnp.where(bad, jnp.inf, st.restart_score),
+        score=jnp.where(bad, jnp.inf, st.score),
+        guard_resets=st.guard_resets + bad.astype(jnp.int32),
+        done=st.done | give_up,
+    )
+
+
 def _use_pallas_window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> bool:
     """Engine choice, resolved at TRACE time (all inputs static)."""
     if opts.use_pallas is not None:
@@ -339,6 +398,8 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
             lambda _, s: _pdhg_iter(p, s, tau, sigma, prec), st)
     st = dataclasses.replace(st, nwin=st.nwin + opts.restart_period)
     st = _restart(p, st, opts)
+    if opts.lane_guard:
+        st = _lane_guard(p, st, opts)
     return dataclasses.replace(st, k=st.k + opts.restart_period)
 
 

@@ -1,0 +1,4 @@
+from mpisppy_tpu.resilience.faults import (  # noqa: F401
+    CheckpointFault, FaultPlan, LaneFault, PreemptionError,
+    SimulatedPreemption, SpokeBoundFault,
+)

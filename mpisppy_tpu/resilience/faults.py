@@ -1,0 +1,226 @@
+###############################################################################
+# Deterministic fault injection for the cylinder wheel.
+#
+# The reference wheel survives solver/license hiccups with per-scenario
+# solve retries (ref:mpisppy/spopt.py:931-960) and tolerates slow or
+# dead cylinders by never reading stale RMA windows.  The TPU wheel's
+# failure modes are different — a NaN spoke bound, a diverged PDHG lane,
+# a preemption mid-run (the dominant failure on real TPU pools, cf. the
+# restarted-PDHG robustness discussion in MPAX, arXiv:2412.09734) — and
+# a fault model you cannot *inject* is a fault model you cannot test.
+#
+# A FaultPlan arms named HOST-SIDE seams:
+#
+#   * spoke harvest   — poison a harvested bound (NaN / wrong-sense /
+#                       stale) between `sp.harvest()` and the hub's
+#                       bound bookkeeping (hub._harvest_all);
+#   * PDHG lanes      — scale or NaN chosen scenario lanes of the hub
+#                       solver state at a hub iteration, forcing the
+#                       per-lane divergence guard in ops/pdhg.py to fire
+#                       at the next restart boundary (hub.sync);
+#   * checkpoint      — tear (truncate) or corrupt (bit-flip) a rotated
+#                       checkpoint file right after it lands on disk
+#                       (hub._write_checkpoint);
+#   * preemption      — raise SimulatedPreemption at hub iteration k
+#                       (hub.sync), exercising the emergency-save +
+#                       restore-from-checkpoint path end to end.
+#
+# Every seam is a plain Python call on the host driver loop: NOTHING
+# enters the jitted graph, so a disarmed (or absent) plan has zero
+# overhead and zero trace impact — the jitted step HLO is byte-identical
+# with and without the resilience layer (tests/test_chaos.py asserts
+# this).  Injection is deterministic: seams fire at configured hub
+# iterations / write indices, and any randomness (corruption offsets)
+# comes from the plan's own seeded generator.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+class PreemptionError(RuntimeError):
+    """The run must stop NOW and persist state (SIGTERM/SIGINT on a
+    preemptible pool, or a simulated preemption from a FaultPlan).
+    WheelSpinner.spin catches this, writes a synchronous emergency
+    checkpoint, and re-raises so the caller can exit/restart."""
+
+
+class SimulatedPreemption(PreemptionError):
+    """Preemption injected by a FaultPlan (not a real signal)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SpokeBoundFault:
+    """Poison a spoke's harvested bound at the hub harvest seam.
+
+    kind: 'nan'          -> bound becomes NaN
+          'wrong_sense'  -> outer bounds jump UP past the incumbent,
+                            inner bounds jump DOWN past the outer bound
+                            (sense-violating by `magnitude`)
+          'stale'        -> re-deliver the first bound ever harvested
+                            from this spoke (a slow cylinder's old
+                            window content)
+    spoke_index: which spoke (position in hub.spokes); None = every one.
+    at_iters: hub iterations to fire on; empty = every iteration.
+    """
+
+    kind: str
+    spoke_index: int | None = None
+    at_iters: tuple[int, ...] = ()
+    magnitude: float = 1e8
+
+    def __post_init__(self):
+        if self.kind not in ("nan", "wrong_sense", "stale"):
+            raise ValueError(f"unknown spoke-bound fault {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneFault:
+    """Corrupt chosen scenario lanes of the hub's PDHG solver state at
+    hub iteration `at_iter` (host-side, between jitted steps).
+
+    mode: 'scale' multiplies x/y by `scale` (forces the magnitude
+    branch of the lane guard); 'nan' sets them to NaN (forces the
+    non-finite branch — NaN never self-heals, so recovery proves the
+    quarantine reset works)."""
+
+    at_iter: int
+    lanes: tuple[int, ...]
+    mode: str = "scale"
+    scale: float = 1e25
+
+    def __post_init__(self):
+        if self.mode not in ("scale", "nan"):
+            raise ValueError(f"unknown lane fault mode {self.mode!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointFault:
+    """Damage the `at_write`-th completed checkpoint file (0-based).
+
+    kind: 'torn' truncates the file to half (a kill mid-write on a
+    non-atomic filesystem); 'corrupt' flips bytes in the middle (bit
+    rot — survives np.load, caught by the checksum)."""
+
+    kind: str
+    at_write: int = 0
+
+    def __post_init__(self):
+        if self.kind not in ("torn", "corrupt"):
+            raise ValueError(f"unknown checkpoint fault {self.kind!r}")
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults for one wheel run.
+
+    Build one, put it in the hub options as ``options['fault_plan']``,
+    and spin.  The hub and WheelSpinner call the seam methods below at
+    the named points; a plan with no faults armed (or no plan at all)
+    never changes behavior.  ``plan.fired`` records every injection as
+    ``(seam, detail)`` tuples so tests can assert the schedule ran.
+    """
+
+    def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
+                 checkpoints=(), preempt_at_iter: int | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.spoke_bounds = tuple(spoke_bounds)
+        self.lanes = tuple(lanes)
+        self.checkpoints = tuple(checkpoints)
+        self.preempt_at_iter = preempt_at_iter
+        self.fired: list[tuple[str, str]] = []
+        self._writes = 0
+        self._first_seen: dict[int, float] = {}
+        self._preempted = False
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.spoke_bounds or self.lanes or self.checkpoints
+                    or self.preempt_at_iter is not None)
+
+    # -- seam: spoke harvest (hub._harvest_all) ---------------------------
+    def filter_bound(self, spoke_index: int, sense: str, bound: float,
+                     hub_iter: int) -> float:
+        """Return the (possibly poisoned) bound the hub should see."""
+        if spoke_index not in self._first_seen and np.isfinite(bound):
+            self._first_seen[spoke_index] = bound
+        for f in self.spoke_bounds:
+            if f.spoke_index is not None and f.spoke_index != spoke_index:
+                continue
+            if f.at_iters and hub_iter not in f.at_iters:
+                continue
+            if f.kind == "nan":
+                poisoned = float("nan")
+            elif f.kind == "wrong_sense":
+                poisoned = bound + f.magnitude if sense == "outer" \
+                    else bound - f.magnitude
+            else:  # stale
+                poisoned = self._first_seen.get(spoke_index, bound)
+            self.fired.append(
+                ("spoke_bound",
+                 f"{f.kind} spoke{spoke_index} iter{hub_iter}"))
+            return poisoned
+        return bound
+
+    # -- seam: PDHG lanes (hub.sync, host-side) ---------------------------
+    def corrupt_lanes(self, hub_iter: int, opt) -> bool:
+        """Scale/NaN the configured lanes of opt.state.solver.  Returns
+        True when something was corrupted."""
+        todo = [f for f in self.lanes if f.at_iter == hub_iter]
+        if not todo or getattr(opt, "state", None) is None:
+            return False
+        import jax.numpy as jnp
+        st = opt.state
+        solver = st.solver
+        x, y = solver.x, solver.y
+        for f in todo:
+            lanes = np.asarray(f.lanes, np.int32)
+            if f.mode == "scale":
+                x = x.at[lanes].mul(f.scale)
+                y = y.at[lanes].mul(f.scale)
+            else:
+                nan = jnp.asarray(np.nan, x.dtype)
+                x = x.at[lanes].set(nan)
+                y = y.at[lanes].set(nan)
+            self.fired.append(
+                ("lanes", f"{f.mode} lanes{f.lanes} iter{hub_iter}"))
+        opt.state = dataclasses.replace(
+            st, solver=dataclasses.replace(solver, x=x, y=y))
+        # FusedPH carries the authoritative state in wstate; keep the
+        # two views consistent so the corruption is not silently dropped
+        wstate = getattr(opt, "wstate", None)
+        if wstate is not None and wstate.ph is st:
+            opt.wstate = dataclasses.replace(wstate, ph=opt.state)
+        return True
+
+    # -- seam: checkpoint write (hub._write_checkpoint) -------------------
+    def on_checkpoint_written(self, path: str) -> None:
+        """Called after a checkpoint file fully lands (post-rename)."""
+        idx = self._writes
+        self._writes += 1
+        for f in self.checkpoints:
+            if f.at_write != idx:
+                continue
+            import os
+            size = os.path.getsize(path)
+            if f.kind == "torn":
+                with open(path, "r+b") as fh:
+                    fh.truncate(max(1, size // 2))
+            else:  # corrupt: flip bytes in the middle of the file
+                off = size // 3 + int(self.rng.integers(0, max(1, size // 3)))
+                with open(path, "r+b") as fh:
+                    fh.seek(off)
+                    chunk = fh.read(8)
+                    fh.seek(off)
+                    fh.write(bytes(b ^ 0xFF for b in chunk))
+            self.fired.append(("checkpoint", f"{f.kind} write{idx} {path}"))
+
+    # -- seam: preemption (hub.sync) --------------------------------------
+    def maybe_preempt(self, hub_iter: int) -> None:
+        if (self.preempt_at_iter is not None and not self._preempted
+                and hub_iter >= self.preempt_at_iter):
+            self._preempted = True
+            self.fired.append(("preemption", f"iter{hub_iter}"))
+            raise SimulatedPreemption(
+                f"simulated preemption at hub iteration {hub_iter}")

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from mpisppy_tpu import global_toc
+from mpisppy_tpu.resilience.faults import PreemptionError
 
 
 class WheelSpinner:
@@ -30,6 +31,7 @@ class WheelSpinner:
         self.spcomm = None
         self.opt = None
         self.on_hub = True  # single-process: we always "are" the hub
+        self.preempted = False
 
     def build(self):
         """Construct opt + spokes + hub without running (split out so a
@@ -58,15 +60,75 @@ class WheelSpinner:
     def spin(self, comm_world=None):
         """Build opt + hub + spokes, run the hub algorithm to
         completion, terminate + finalize the spokes
-        (ref:spin_the_wheel.py:43-149 run())."""
+        (ref:spin_the_wheel.py:43-149 run()).
+
+        Preemption tolerance (docs/resilience.md): when the hub is
+        configured with a checkpoint_path, SIGTERM/SIGINT are converted
+        to PreemptionError, which triggers one SYNCHRONOUS emergency
+        checkpoint before re-raising — on a preemptible TPU pool the
+        eviction signal arrives seconds before the kill, exactly enough
+        for a last-gasp save.  A later run restores via
+        hub.load_checkpoint and resumes mid-loop."""
         self.build()
         global_toc("Starting wheel spin", False)
-        self.spcomm.main()
+        ckpt_path = self.spcomm.options.get("checkpoint_path")
+        prev_handlers = self._install_preemption_handlers() \
+            if ckpt_path else None
+        try:
+            self.spcomm.main()
+        except PreemptionError:
+            self.preempted = True
+            if ckpt_path:
+                saved = self.spcomm.emergency_checkpoint(ckpt_path)
+                global_toc(
+                    f"preempted: emergency checkpoint "
+                    f"{'written to ' + ckpt_path if saved else 'SKIPPED'}"
+                    f" at hub iter {self.spcomm._iter}", True)
+            raise
+        finally:
+            self._restore_preemption_handlers(prev_handlers)
         self.spcomm.send_terminate()
         self.spcomm.finalize()
         self.spcomm.hub_finalize()
         self.spcomm.free_windows()
         return self
+
+    # -- preemption signal plumbing ---------------------------------------
+    @staticmethod
+    def _install_preemption_handlers():
+        """SIGTERM/SIGINT -> PreemptionError (raised at the next
+        bytecode boundary of the host loop, i.e. between device
+        dispatches).  Returns the previous handlers for restoration;
+        None when not on the main thread (signal.signal would raise)."""
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return None
+
+        fired = []
+
+        def _handler(signum, frame):
+            # latch: a second SIGTERM/SIGINT (impatient scheduler,
+            # double Ctrl-C) must not unwind the emergency save that
+            # the FIRST signal triggered — the partial .tmp would never
+            # be renamed and the last-gasp snapshot would be lost
+            if fired:
+                return
+            fired.append(signum)
+            raise PreemptionError(f"received signal {signum}")
+
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, _handler)
+        return prev
+
+    @staticmethod
+    def _restore_preemption_handlers(prev):
+        if not prev:
+            return
+        import signal
+        for sig, h in prev.items():
+            signal.signal(sig, h)
 
     # -- results (ref:spin_the_wheel.py:151-222) --------------------------
     @property

@@ -20,17 +20,27 @@ from mpisppy_tpu.ops import pdhg
 
 
 def _pdhg_opts(cfg) -> pdhg.PDHGOptions:
-    return pdhg.PDHGOptions(tol=cfg.get("pdhg_tol", 1e-6))
+    return pdhg.PDHGOptions(
+        tol=cfg.get("pdhg_tol", 1e-6),
+        lane_guard=bool(cfg.get("lane_guard", False)),
+        guard_max_resets=cfg.get("guard_max_resets", 3))
 
 
 def _hub_opts(cfg) -> dict:
-    """Shared hub termination options (ref:hub.py:82-166 inputs)."""
+    """Shared hub termination options (ref:hub.py:82-166 inputs) plus
+    the resilience knobs (checkpointing / strike policy,
+    docs/resilience.md)."""
     hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
                 "display_progress": cfg.get("display_progress", False)}
     if cfg.get("abs_gap") is not None:
         hub_opts["abs_gap"] = cfg["abs_gap"]
     if cfg.get("max_stalled_iters") is not None:
         hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
+    for key in ("checkpoint_path", "checkpoint_every_s",
+                "checkpoint_keep", "spoke_max_strikes", "bound_slack",
+                "bound_evict_contras"):
+        if cfg.get(key) is not None:
+            hub_opts[key] = cfg[key]
     return hub_opts
 
 
@@ -110,13 +120,18 @@ def lshaped_hub(cfg, batch, scenario_names=None) -> dict:
     from mpisppy_tpu.algos import lshaped as ls_mod
     hub_opts = _hub_opts(cfg)
     tol = cfg.get("pdhg_tol", 1e-7)
+    guard = bool(cfg.get("lane_guard", False))
+    guard_resets = cfg.get("guard_max_resets", 3)
     ls_opts = ls_mod.LShapedOptions(
         max_iter=cfg.get("lshaped_max_iter", 50),
         tol=cfg.get("rel_gap", 1e-4),
         multicut=cfg.get("lshaped_multicut", False),
         sub_pdhg=pdhg.PDHGOptions(tol=tol, max_iters=100_000,
-                                  detect_infeas=True),
-        master_pdhg=pdhg.PDHGOptions(tol=tol, max_iters=200_000),
+                                  detect_infeas=True, lane_guard=guard,
+                                  guard_max_resets=guard_resets),
+        master_pdhg=pdhg.PDHGOptions(tol=tol, max_iters=200_000,
+                                     lane_guard=guard,
+                                     guard_max_resets=guard_resets),
         display_progress=cfg.get("display_progress", False),
     )
     return {

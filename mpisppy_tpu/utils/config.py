@@ -346,6 +346,42 @@ class Config:
         self.add_to_config("iter0_windows",
                            "PDHG restart windows for iter0", int, 400)
 
+    def resilience_args(self):
+        """Chaos/graceful-degradation knobs (docs/resilience.md):
+        preemption-tolerant checkpointing, spoke strike policy, and the
+        PDHG per-lane divergence guard.  No reference analog — the
+        reference leans on exact-solver retries (ref:spopt.py:931-960)."""
+        self.add_to_config("checkpoint_path",
+                           "rotated wheel checkpoint file; also enables "
+                           "the SIGTERM/SIGINT emergency save",
+                           str, None)
+        self.add_to_config("checkpoint_every_s",
+                           "seconds between background checkpoints",
+                           float, 60.0)
+        self.add_to_config("checkpoint_keep",
+                           "rotated snapshots kept (path, path.1, ...; "
+                           "minimum 2)", int, 2)
+        self.add_to_config("checkpoint_restore",
+                           "resume from the newest valid snapshot when "
+                           "one exists at checkpoint-path",
+                           bool, False)
+        self.add_to_config("spoke_max_strikes",
+                           "auto-disable a spoke after this many "
+                           "rejected (non-finite/sense-violating) bounds",
+                           int, 3)
+        self.add_to_config("bound_slack",
+                           "relative slack for sense-violation bound "
+                           "rejection", float, 5e-3)
+        self.add_to_config("bound_evict_contras",
+                           "distinct contradicting spokes that evict a "
+                           "standing incumbent bound", int, 3)
+        self.add_to_config("lane_guard",
+                           "quarantine-reset diverged PDHG scenario "
+                           "lanes at restart boundaries", bool, False)
+        self.add_to_config("guard_max_resets",
+                           "bounded quarantine retries per PDHG lane",
+                           int, 3)
+
     def checker(self):
         """Cross-option validation (ref:config.py:143-157)."""
         if self.get("smoothed") and self.get("defaultPHp", 0.0) < 0:

@@ -84,6 +84,29 @@ def ROOT_xbar_npy_serializer(ph, fname: str):
 
 
 # ---- full-state checkpointing (SURVEY §5: reference gap) ----------------
+def validate_state_leaves(arrays: dict, leaves) -> None:
+    """Checkpoint-compatibility gate shared by every state restore path
+    (hub.load_checkpoint and load_ph_state): each flattened leaf{i} must
+    be present with the exact expected shape AND dtype — a float64 leaf
+    silently upcasting a float32 state would poison every downstream
+    jit cache.  Raises ValueError on the first incompatibility."""
+    n = len(leaves)
+    missing = [i for i in range(n) if f"leaf{i}" not in arrays]
+    if missing:
+        raise ValueError(f"checkpoint missing leaves {missing} "
+                         f"(different problem/options?)")
+    for i in range(n):
+        a, b = arrays[f"leaf{i}"], leaves[i]
+        if tuple(a.shape) != tuple(b.shape):
+            raise ValueError(
+                f"checkpoint leaf {i} shape {tuple(a.shape)} != expected "
+                f"{tuple(b.shape)} (different problem/options?)")
+        if np.dtype(a.dtype) != np.dtype(b.dtype):
+            raise ValueError(
+                f"checkpoint leaf {i} dtype {a.dtype} != expected "
+                f"{np.dtype(b.dtype)} (different problem/options?)")
+
+
 def save_ph_state(fname: str, ph):
     """npz snapshot of every PHState leaf + iteration counter; exact
     resume (same shapes) via load_ph_state."""
@@ -96,14 +119,13 @@ def save_ph_state(fname: str, ph):
 def load_ph_state(fname: str, ph):
     import jax
     import jax.numpy as jnp
-    data = np.load(fname)
+    # NpzFile holds an open zip handle — close it (context manager)
+    # instead of leaking it
+    with np.load(fname) as data:
+        arrays = {k: np.asarray(data[k]) for k in data.files}
     leaves, treedef = jax.tree.flatten(ph.state)
-    n = len(leaves)
-    new = [jnp.asarray(data[f"leaf{i}"], leaves[i].dtype) for i in range(n)]
-    for i in range(n):
-        if new[i].shape != leaves[i].shape:
-            raise ValueError(
-                f"checkpoint leaf {i} shape {new[i].shape} != current "
-                f"{leaves[i].shape} (different problem/options?)")
+    validate_state_leaves(arrays, leaves)
+    new = [jnp.asarray(arrays[f"leaf{i}"], leaves[i].dtype)
+           for i in range(len(leaves))]
     ph.state = jax.tree.unflatten(treedef, new)
-    ph._iter = int(data["_iter"])
+    ph._iter = int(arrays["_iter"])

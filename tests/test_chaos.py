@@ -1,0 +1,589 @@
+# Chaos harness: deterministic fault injection (resilience/faults) +
+# the graceful-degradation guards it exercises — per-lane PDHG
+# divergence quarantine (ops/pdhg), hub bound validation with spoke
+# strike/disable policy (cylinders/hub), and preemption-tolerant
+# rotated/checksummed checkpoints (hub + spin_the_wheel).  The
+# reference's analog is per-scenario solve retries
+# (ref:mpisppy/spopt.py:931-960); the TPU wheel's fault model is
+# documented in docs/resilience.md.
+import dataclasses
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpisppy_tpu.algos import ph as ph_mod
+from mpisppy_tpu.core import batch as batch_mod
+from mpisppy_tpu.cylinders import (
+    ConvergerSpokeType, PHHub, LagrangianOuterBound, XhatXbarInnerBound,
+)
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.resilience import (
+    CheckpointFault, FaultPlan, LaneFault, SimulatedPreemption,
+    SpokeBoundFault,
+)
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+pytestmark = pytest.mark.chaos
+
+FARMER_EF_OBJ = -108390.0
+
+
+def farmer_batch(num_scens=3):
+    names = farmer.scenario_names_creator(num_scens)
+    specs = [farmer.scenario_creator(nm, num_scens=num_scens)
+             for nm in names]
+    return batch_mod.from_specs(specs)
+
+
+def ph_options(max_iterations=150, lane_guard=True):
+    return ph_mod.PHOptions(
+        default_rho=1.0, max_iterations=max_iterations, conv_thresh=0.0,
+        subproblem_windows=10,
+        pdhg=pdhg.PDHGOptions(tol=1e-7, lane_guard=lane_guard))
+
+
+def hub_dict(batch, hub_extra=None, max_iterations=150, rel_gap=5e-3,
+             lane_guard=True):
+    hub_opts = {"rel_gap": rel_gap}
+    hub_opts.update(hub_extra or {})
+    return {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": hub_opts},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_options(max_iterations, lane_guard),
+                       "batch": batch},
+    }
+
+
+BOTH_SPOKES = [
+    {"spoke_class": LagrangianOuterBound, "opt_kwargs": {"options": {}}},
+    {"spoke_class": XhatXbarInnerBound, "opt_kwargs": {"options": {}}},
+]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance round trip: NaN + wrong-sense + stale bounds, forced
+# lane divergence, and a simulated preemption + restore — the final
+# certified bounds must match the fault-free run.
+# ---------------------------------------------------------------------------
+def test_chaos_round_trip(tmp_path):
+    batch = farmer_batch(3)
+
+    # fault-free reference run
+    ws0 = WheelSpinner(hub_dict(batch), [dict(d) for d in BOTH_SPOKES])
+    ws0.spin()
+    assert np.isfinite(ws0.BestInnerBound) and np.isfinite(ws0.BestOuterBound)
+
+    # chaos run: same wheel under a seeded FaultPlan
+    ckpt = str(tmp_path / "wheel.npz")
+    plan = FaultPlan(
+        seed=42,
+        spoke_bounds=(
+            SpokeBoundFault("nan", spoke_index=0, at_iters=(3, 4)),
+            SpokeBoundFault("wrong_sense", spoke_index=1, at_iters=(4,),
+                            magnitude=1e8),
+            SpokeBoundFault("stale", spoke_index=1, at_iters=(5,)),
+        ),
+        lanes=(LaneFault(at_iter=3, lanes=(1,), mode="scale", scale=1e25),
+               LaneFault(at_iter=5, lanes=(0,), mode="nan")),
+        preempt_at_iter=7,
+    )
+    assert plan.armed
+    hub_extra = {"fault_plan": plan, "checkpoint_path": ckpt,
+                 "checkpoint_every_s": 1e9,  # emergency save only
+                 "spoke_max_strikes": 10}
+    ws1 = WheelSpinner(hub_dict(batch, hub_extra),
+                       [dict(d) for d in BOTH_SPOKES])
+    with pytest.raises(SimulatedPreemption):
+        ws1.spin()
+    assert ws1.preempted
+    assert os.path.exists(ckpt)
+    seams = {s for s, _ in plan.fired}
+    assert seams == {"spoke_bound", "lanes", "preemption"}
+    # the NaN harvests struck (unambiguous garbage) but stayed below
+    # the disable threshold; the wrong-sense harvest was rejected as an
+    # ambiguous contradiction — no strike
+    assert ws1.spcomm.spokes[0].strikes == 2   # two NaN harvests
+    assert ws1.spcomm.spokes[1].strikes == 0
+    assert not any(sp.disabled for sp in ws1.spcomm.spokes)
+    # mid-chaos bookkeeping is still finite and sense-correct
+    ob1, ib1 = ws1.BestOuterBound, ws1.BestInnerBound
+    assert np.isfinite(ob1) and np.isfinite(ib1)
+    assert ob1 <= ib1 + 5e-3 * abs(ib1)
+
+    # restore into a fresh wheel (no plan) and resume to termination
+    ws2 = WheelSpinner(hub_dict(batch, {"checkpoint_path": ckpt}),
+                       [dict(d) for d in BOTH_SPOKES]).build()
+    ws2.spcomm.load_checkpoint(ckpt)
+    assert ws2.spcomm._iter == 7  # the emergency save's sync point
+    # the lane guard fired on the corrupted lanes and its counters
+    # rode along in the checkpoint
+    resets = np.asarray(ws2.opt.state.solver.guard_resets)
+    assert resets.max() >= 1
+    assert np.all(np.isfinite(np.asarray(ws2.opt.state.solver.x)))
+    ws2.spin()
+
+    # certified termination, and bounds match the fault-free run
+    inner0, outer0 = ws0.BestInnerBound, ws0.BestOuterBound
+    inner2, outer2 = ws2.BestInnerBound, ws2.BestOuterBound
+    assert np.isfinite(inner2) and np.isfinite(outer2)
+    assert outer2 <= inner2 + 2e-3 * abs(inner2)          # sense-correct
+    _, rel_gap = ws2.spcomm.compute_gaps()
+    assert rel_gap <= 5e-3 + 1e-6                         # certified
+    slack = 2e-3 * abs(FARMER_EF_OBJ)
+    assert outer2 <= FARMER_EF_OBJ + slack                # valid bracket
+    assert inner2 >= FARMER_EF_OBJ - slack
+    assert inner2 == pytest.approx(inner0, rel=1e-2)      # matches
+    assert outer2 == pytest.approx(outer0, rel=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# No-overhead contract: a disarmed FaultPlan leaves the jitted hub step
+# byte-identical to a build that never touched the resilience layer.
+# ---------------------------------------------------------------------------
+def test_disarmed_plan_hlo_identical():
+    batch = farmer_batch(3)
+    opts = ph_mod.kernel_opts(ph_mod.PHOptions(
+        default_rho=1.0, conv_thresh=0.0, subproblem_windows=10,
+        pdhg=pdhg.PDHGOptions(tol=1e-7)))
+    rho = jnp.ones((batch.num_nonants,), batch.qp.c.dtype)
+    # baseline: direct driver, no resilience objects anywhere
+    st, _, _ = ph_mod.ph_iter0(batch, rho, opts)
+    text_base = ph_mod.ph_iterk.lower(batch, st, opts).as_text()
+
+    # the same step lowered from a wheel carrying a DISARMED plan
+    plan = FaultPlan(seed=7)
+    assert not plan.armed
+    ws = WheelSpinner(
+        hub_dict(batch, {"fault_plan": plan}, max_iterations=3,
+                 rel_gap=5e-3, lane_guard=False),
+        [dict(d) for d in BOTH_SPOKES]).spin()
+    text_plan = ph_mod.ph_iterk.lower(
+        batch, ws.opt.state, ph_mod.kernel_opts(ws.opt.options)).as_text()
+    assert text_plan == text_base
+    assert plan.fired == []
+
+
+# ---------------------------------------------------------------------------
+# Lane guard unit behavior
+# ---------------------------------------------------------------------------
+def test_lane_guard_quarantines_nan_lane():
+    batch = farmer_batch(3)
+    opts = pdhg.PDHGOptions(tol=1e-6, lane_guard=True, max_iters=40_000)
+    st = pdhg.solve_fixed(batch.qp, 3, opts,
+                          pdhg.init_state(batch.qp, opts))
+    nan = jnp.asarray(np.nan, st.x.dtype)
+    st = dataclasses.replace(st, x=st.x.at[1].set(nan),
+                             y=st.y.at[1].set(nan))
+    out = pdhg.solve(batch.qp, opts, st)
+    resets = np.asarray(out.guard_resets)
+    assert np.all(np.asarray(out.done))
+    assert np.all(np.asarray(out.status) == pdhg.OPTIMAL)
+    assert resets[1] >= 1 and resets[0] == 0 and resets[2] == 0
+    # the quarantined lane re-converged to the clean solution
+    clean = pdhg.solve(batch.qp,
+                       pdhg.PDHGOptions(tol=1e-6, max_iters=40_000))
+    np.testing.assert_allclose(np.asarray(out.x[1]),
+                               np.asarray(clean.x[1]),
+                               rtol=1e-3, atol=1e-2)
+
+
+def test_lane_guard_bounded_retries_freeze_lane():
+    """A lane past guard_max_resets is frozen done with status RUNNING
+    (never certified) instead of burning max_iters forever."""
+    batch = farmer_batch(3)
+    opts = pdhg.PDHGOptions(tol=1e-6, lane_guard=True, max_iters=4_000,
+                            guard_max_resets=2)
+    st = pdhg.init_state(batch.qp, opts)
+    st = dataclasses.replace(
+        st, guard_resets=st.guard_resets.at[2].set(99),
+        x=st.x.at[2].set(jnp.asarray(np.nan, st.x.dtype)))
+    out = pdhg.solve(batch.qp, opts, st)
+    assert bool(out.done[2])
+    assert int(out.status[2]) == pdhg.RUNNING  # unconverged, uncertified
+    # the frozen lane holds CLEAN iterates — downstream consumers (PH's
+    # unmasked xbar/W node averages) must never see the poisoned ones
+    assert np.all(np.isfinite(np.asarray(out.x[2])))
+    assert np.all(np.isfinite(np.asarray(out.y[2])))
+    # healthy lanes unaffected
+    assert int(out.status[0]) == pdhg.OPTIMAL
+    assert int(out.status[1]) == pdhg.OPTIMAL
+
+
+def test_lane_guard_off_is_default_and_nan_sticks():
+    """Without the guard a NaN lane can never converge — the behavior
+    the guard exists to fix (and proof the default program is
+    unchanged: guard fields ride along but no guard ops run)."""
+    batch = farmer_batch(3)
+    opts = pdhg.PDHGOptions(tol=1e-6, max_iters=2_000)
+    assert opts.lane_guard is False
+    st = pdhg.init_state(batch.qp, opts)
+    st = dataclasses.replace(
+        st, y=st.y.at[0].set(jnp.asarray(np.nan, st.y.dtype)))
+    out = pdhg.solve(batch.qp, opts, st)
+    assert not bool(out.done[0])
+    assert int(np.asarray(out.guard_resets).max()) == 0
+
+
+# ---------------------------------------------------------------------------
+# Hub bound validation + strike/disable policy
+# ---------------------------------------------------------------------------
+class ScriptedSpoke:
+    """Harvest a scripted sequence of bounds (then None)."""
+
+    converger_spoke_char = "Z"
+
+    def __init__(self, values, sense="outer"):
+        self.converger_spoke_types = (
+            (ConvergerSpokeType.OUTER_BOUND,) if sense == "outer"
+            else (ConvergerSpokeType.INNER_BOUND,))
+        self.values = list(values)
+        self.bound = None
+        self.best_xhat = None
+        self.trace = []
+        self.strikes = 0
+        self.disabled = False
+        self.harvest_calls = 0
+
+    def harvest(self):
+        self.harvest_calls += 1
+        return self.values.pop(0) if self.values else None
+
+    def update(self, payload):
+        pass
+
+
+def _bare_hub(options, spokes):
+    hub = PHHub(opt=None, options=options, spokes=spokes)
+    return hub
+
+
+def test_hub_rejects_nonfinite_and_sense_violations():
+    good = ScriptedSpoke([-110.0, -109.0], sense="outer")
+    hub = _bare_hub({"spoke_max_strikes": 3}, [good])
+    hub.BestInnerBound = -100.0
+    hub._harvest_all()
+    assert hub.BestOuterBound == -110.0
+
+    # non-finite updates can never move the bookkeeping
+    assert hub.OuterBoundUpdate(math.nan) == -110.0
+    assert hub.OuterBoundUpdate(math.inf) == -110.0
+    assert hub.InnerBoundUpdate(-math.inf) == -100.0
+
+    # a sense-violating outer bound (crossing the incumbent) is
+    # rejected — no fold, no trace entry, no strike (the evidence is
+    # ambiguous): it is recorded as a contradiction of the incumbent
+    bad = ScriptedSpoke([-50.0], sense="outer")
+    hub.spokes = [bad]
+    hub._harvest_all()
+    assert hub.BestOuterBound == -110.0
+    assert bad.strikes == 0
+    assert bad.trace == []
+    assert hub._contra["inner"] == [bad]
+
+
+def test_hub_strikes_disable_spoke_and_wheel_continues():
+    bad = ScriptedSpoke([math.nan] * 10, sense="outer")
+    good = ScriptedSpoke([-120.0, -115.0, -112.0, -111.0], sense="outer")
+    hub = _bare_hub({"spoke_max_strikes": 2}, [bad, good])
+    hub.BestInnerBound = -100.0
+    for _ in range(4):
+        hub._harvest_all()
+    assert bad.disabled
+    assert bad.strikes == 2
+    # harvest stopped being called once disabled
+    assert bad.harvest_calls == 2
+    # the healthy spoke kept feeding the hub throughout
+    assert good.harvest_calls == 4
+    assert hub.BestOuterBound == -111.0
+
+
+def test_poisoned_early_incumbent_is_evicted_by_distinct_contradictors():
+    """A wrong-sense outer bound accepted BEFORE any inner exists (so
+    sense validation could not catch it) must not poison the monotone
+    BestOuterBound forever: contradictions from enough DISTINCT spokes
+    evict it — without blaming anyone, since the evidence stays
+    ambiguous — and the healthy bounds land on the next sweep."""
+    rogue = ScriptedSpoke([1e7], sense="outer")   # garbage, accepted at
+    goods = [ScriptedSpoke([-100.0] * 2, sense="inner")  # an empty hub
+             for _ in range(3)]
+    hub = _bare_hub({}, [rogue] + goods)
+    hub._harvest_all()
+    # three distinct contradictors -> incumbent evicted mid-sweep
+    assert hub.BestOuterBound == -math.inf
+    hub._harvest_all()
+    assert hub.BestInnerBound == -100.0
+    assert all(g.strikes == 0 and not g.disabled for g in goods)
+    assert rogue.strikes == 0   # ambiguous evidence never strikes
+
+
+def test_lone_contradictor_cannot_evict_a_confirmed_incumbent():
+    """One persistently rogue spoke must never out-vote the standing
+    incumbent: its garbage is rejected every sync (and scrubbed, so a
+    cached spike cannot re-offer itself), but the incumbent stands and
+    nobody is struck or disabled."""
+    class CachingSpoke(ScriptedSpoke):
+        def harvest(self):  # the monotone-cache shape of real spokes
+            self.harvest_calls += 1
+            if self.values:
+                b = self.values.pop(0)
+                if self.bound is None or b > self.bound:
+                    self.bound = b
+            return self.bound
+
+    sp = CachingSpoke([-50.0], sense="outer")  # one spike, then cache
+    hub = _bare_hub({"spoke_max_strikes": 3}, [sp])
+    hub.BestInnerBound = -100.0
+    for _ in range(6):
+        hub._harvest_all()
+    assert sp.strikes == 0
+    assert not sp.disabled
+    assert hub.BestInnerBound == -100.0       # incumbent untouched
+    assert hub._contra["inner"] == [sp]       # dissent logged ONCE
+    sp.values = [-110.0]                      # the spoke recovers
+    hub._harvest_all()
+    assert hub.BestOuterBound == -110.0
+    assert hub._contra["inner"] == []         # consistency clears it
+
+
+def test_best_nonants_ignores_nan_incumbent():
+    nan_sp = ScriptedSpoke([], sense="inner")
+    nan_sp.bound = math.nan
+    nan_sp.best_xhat = np.full((1, 2), 77.0)
+    good = ScriptedSpoke([], sense="inner")
+    good.bound = -105.0
+    good.best_xhat = np.full((1, 2), 5.0)
+    hub = _bare_hub({}, [nan_sp, good])
+    np.testing.assert_array_equal(hub.best_nonants(),
+                                  np.full((1, 2), 5.0))
+
+
+def test_best_nonants_survives_disabled_incumbent_producer():
+    """BestInnerBound keeps previously accepted values even after the
+    producing spoke goes rogue and is disabled — the hub-side incumbent
+    cache must keep backing the reported bound with its solution."""
+    sp = ScriptedSpoke([-105.0, math.nan, math.nan], sense="inner")
+    sp.best_xhat = np.full((1, 2), 7.0)
+    hub = _bare_hub({"spoke_max_strikes": 2}, [sp])
+    for _ in range(3):
+        hub._harvest_all()
+    assert hub.BestInnerBound == -105.0   # accepted value retained
+    assert sp.disabled                    # then the producer died
+    np.testing.assert_array_equal(hub.best_nonants(),
+                                  np.full((1, 2), 7.0))
+
+
+def test_lane_guard_reaches_fused_planes():
+    """--lane-guard must guard the fused bound planes' PDHG options,
+    not only the hub's subproblem solves."""
+    from mpisppy_tpu import generic_cylinders as gc
+    from mpisppy_tpu.cylinders import spoke as spoke_mod
+    from mpisppy_tpu.utils.config import Config
+    cfg = Config()
+    cfg.resilience_args()
+    cfg.lane_guard = True
+    spokes = [{"spoke_class": spoke_mod.LagrangianOuterBound,
+               "opt_kwargs": {"options": {}}},
+              {"spoke_class": spoke_mod.XhatXbarInnerBound,
+               "opt_kwargs": {"options": {}}}]
+    hub2, _ = gc._fuse_wheel(cfg, {"opt_kwargs": {}}, spokes)
+    wopts = hub2["opt_kwargs"]["wheel_options"]
+    assert wopts.lag_pdhg.lane_guard
+    assert wopts.xhat_pdhg.lane_guard
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint rotation, checksum, fallback, cadence
+# ---------------------------------------------------------------------------
+def _spun_wheel_with_ckpt_opts(tmp_path, plan=None, keep=3):
+    batch = farmer_batch(3)
+    ckpt = str(tmp_path / "w.npz")
+    hub_extra = {"checkpoint_path": ckpt, "checkpoint_every_s": 1e9,
+                 "checkpoint_keep": keep}
+    if plan is not None:
+        hub_extra["fault_plan"] = plan
+    ws = WheelSpinner(hub_dict(batch, hub_extra, max_iterations=4),
+                      [dict(d) for d in BOTH_SPOKES]).spin()
+    return ws, ckpt, batch
+
+
+def test_torn_checkpoint_falls_back_to_rotated(tmp_path):
+    # tear the SECOND write (the newest file) mid-stream — the kill-mid-
+    # write case on a non-atomic filesystem
+    plan = FaultPlan(seed=3, checkpoints=(
+        CheckpointFault("torn", at_write=1),))
+    ws, ckpt, batch = _spun_wheel_with_ckpt_opts(tmp_path, plan)
+    hub = ws.spcomm
+    assert hub.save_checkpoint(ckpt)          # write 0: clean
+    it_saved = hub._iter
+    hub._iter += 1                            # pretend progress
+    assert hub.save_checkpoint(ckpt)          # write 1: torn by the plan
+    assert ("checkpoint", f"torn write1 {ckpt}") in plan.fired
+    assert os.path.exists(ckpt + ".1")
+
+    ws2 = WheelSpinner(
+        hub_dict(batch, {"checkpoint_path": ckpt}, max_iterations=4),
+        [dict(d) for d in BOTH_SPOKES]).build()
+    ws2.spcomm.load_checkpoint(ckpt)
+    # the torn newest file was skipped; the last-good rotated snapshot
+    # (write 0, at it_saved) restored
+    assert ws2.spcomm._iter == it_saved
+    assert np.isfinite(ws2.spcomm.BestOuterBound)
+
+
+def test_corrupt_checkpoint_falls_back_to_rotated(tmp_path):
+    plan = FaultPlan(seed=4, checkpoints=(
+        CheckpointFault("corrupt", at_write=1),))
+    ws, ckpt, batch = _spun_wheel_with_ckpt_opts(tmp_path, plan)
+    hub = ws.spcomm
+    assert hub.save_checkpoint(ckpt)
+    it_saved = hub._iter
+    hub._iter += 1
+    assert hub.save_checkpoint(ckpt)          # bit-flipped by the plan
+    ws2 = WheelSpinner(
+        hub_dict(batch, {"checkpoint_path": ckpt}, max_iterations=4),
+        [dict(d) for d in BOTH_SPOKES]).build()
+    ws2.spcomm.load_checkpoint(ckpt)
+    assert ws2.spcomm._iter == it_saved
+
+
+def test_checksum_rejects_silently_tampered_arrays(tmp_path):
+    """Bit rot that survives the zip layer must be caught by the crc in
+    the meta (the zip member crc only covers what np.load re-reads)."""
+    ws, ckpt, _ = _spun_wheel_with_ckpt_opts(tmp_path)
+    hub = ws.spcomm
+    assert hub.save_checkpoint(ckpt)
+    with np.load(ckpt) as data:
+        arrays = {k: np.asarray(data[k]) for k in data.files}
+    # tamper the bounds but keep the stale crc: re-written zip is
+    # perfectly valid, only OUR checksum can notice
+    arrays["bounds"] = arrays["bounds"] + 1.0
+    np.savez(ckpt, **arrays)
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        hub._read_checkpoint_arrays(ckpt)
+    # all candidates bad -> load_checkpoint raises, not crashes weirdly
+    for cand in hub._checkpoint_candidates(ckpt)[1:]:
+        os.remove(cand)
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        hub.load_checkpoint(ckpt)
+
+
+class _DummyOpt:
+    state = [jnp.zeros(2)]
+    wstate = None
+    trivial_bound = None
+    trivial_bound_certified = False
+    _iter = 0
+
+
+def test_maybe_checkpoint_cadence_not_consumed_by_skipped_save(tmp_path):
+    """Satellite regression: a save skipped because the previous write
+    thread is still alive must NOT advance _last_ckpt_t (the slip that
+    silently halved checkpoint frequency under slow writes)."""
+    ckpt = str(tmp_path / "c.npz")
+    hub = PHHub(opt=_DummyOpt(), options={"checkpoint_path": ckpt,
+                                          "checkpoint_every_s": 0.0})
+    hub._last_ckpt_t = 1.0  # long overdue
+    gate = threading.Event()
+    blocker = threading.Thread(target=gate.wait)
+    blocker.start()
+    hub._ckpt_thread = blocker
+    try:
+        hub._maybe_checkpoint()
+        assert hub._last_ckpt_t == 1.0  # slot NOT consumed: will retry
+        assert not os.path.exists(ckpt)
+    finally:
+        gate.set()
+        blocker.join()
+    hub._maybe_checkpoint()
+    assert hub._last_ckpt_t != 1.0      # the real save consumed it
+    hub._ckpt_thread.join()
+    assert os.path.exists(ckpt)
+
+
+def test_preemption_handlers_installed_and_restored(tmp_path):
+    import signal
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    batch = farmer_batch(3)
+    ckpt = str(tmp_path / "w.npz")
+    WheelSpinner(hub_dict(batch, {"checkpoint_path": ckpt,
+                                  "checkpoint_every_s": 1e9},
+                          max_iterations=2),
+                 [dict(d) for d in BOTH_SPOKES]).spin()
+    assert signal.getsignal(signal.SIGINT) is prev_int
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation end to end: a persistently poisoned spoke is
+# disabled and the wheel still terminates on the survivors.
+# ---------------------------------------------------------------------------
+def test_spoke_auto_disable_wheel_continues():
+    batch = farmer_batch(3)
+    plan = FaultPlan(seed=5, spoke_bounds=(
+        SpokeBoundFault("nan", spoke_index=0),))  # EVERY harvest
+    ws = WheelSpinner(
+        hub_dict(batch, {"fault_plan": plan, "spoke_max_strikes": 2},
+                 max_iterations=40, rel_gap=1e-2),
+        [dict(d) for d in BOTH_SPOKES]).spin()
+    lag = ws.spcomm.spokes[0]
+    assert lag.disabled and lag.strikes == 2
+    # outer bound came from the certified trivial bound ("T"), inner
+    # from the surviving xhat spoke — still a finite, sense-correct,
+    # certified bracket
+    assert np.isfinite(ws.BestOuterBound) and np.isfinite(ws.BestInnerBound)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 2e-3 * abs(
+        ws.BestInnerBound)
+    assert ws.spcomm.latest_ob_char == "T"
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_faults(tmp_path):
+    """Long soak: repeated lane corruption + bound poisoning + two
+    preemption/restore cycles; the wheel must end with a certified
+    bracket matching the fault-free run."""
+    batch = farmer_batch(6)
+    ws0 = WheelSpinner(hub_dict(batch, max_iterations=120),
+                       [dict(d) for d in BOTH_SPOKES]).spin()
+    ckpt = str(tmp_path / "soak.npz")
+    plans = [
+        FaultPlan(seed=11,
+                  spoke_bounds=(SpokeBoundFault("nan", at_iters=(3, 5)),),
+                  lanes=(LaneFault(at_iter=4, lanes=(0, 3), mode="scale",
+                                   scale=1e25),),
+                  preempt_at_iter=6),
+        FaultPlan(seed=12,
+                  lanes=(LaneFault(at_iter=8, lanes=(2,), mode="nan"),),
+                  preempt_at_iter=10),
+    ]
+    hub_extra = {"checkpoint_path": ckpt, "checkpoint_every_s": 1e9,
+                 "spoke_max_strikes": 20}
+    ws = WheelSpinner(hub_dict(batch, {**hub_extra,
+                                       "fault_plan": plans[0]},
+                               max_iterations=120),
+                      [dict(d) for d in BOTH_SPOKES])
+    with pytest.raises(SimulatedPreemption):
+        ws.spin()
+    for plan in plans[1:]:
+        ws = WheelSpinner(hub_dict(batch, {**hub_extra,
+                                           "fault_plan": plan},
+                                   max_iterations=120),
+                          [dict(d) for d in BOTH_SPOKES]).build()
+        ws.spcomm.load_checkpoint(ckpt)
+        with pytest.raises(SimulatedPreemption):
+            ws.spin()
+    ws = WheelSpinner(hub_dict(batch, hub_extra, max_iterations=120),
+                      [dict(d) for d in BOTH_SPOKES]).build()
+    ws.spcomm.load_checkpoint(ckpt)
+    ws.spin()
+    _, rel_gap = ws.spcomm.compute_gaps()
+    assert rel_gap <= 5e-3 + 1e-6
+    assert ws.BestInnerBound == pytest.approx(ws0.BestInnerBound, rel=1e-2)
+    assert ws.BestOuterBound == pytest.approx(ws0.BestOuterBound, rel=1e-2)
