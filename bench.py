@@ -45,6 +45,15 @@ def _enable_compile_cache():
         os.path.dirname(os.path.abspath(__file__)) or ".", ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+def metrics_schema_probe() -> str:
+    """The metrics-snapshot schema this bench embeds in BENCH_*.json —
+    imported from the telemetry exporter, never duplicated, so artifact
+    entries and live --metrics-snapshot files stay comparable by
+    construction (tests/test_telemetry.py asserts it)."""
+    from mpisppy_tpu.telemetry import metrics as metrics_mod
+    return metrics_mod.SNAPSHOT_SCHEMA
+
+
 SSLP_SERVERS, SSLP_CLIENTS = 15, 45
 SSLP_SCENS = 16 if SMOKE else (1_000 if QUICK else 10_000)
 SWEEP = [16] if SMOKE else ([1_000, 10_000] if QUICK
@@ -172,12 +181,31 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
     retries the phase once and this function RESUMES from the
     checkpoint, with elapsed time carried across the crash so the
     reported seconds stay honest.  Returns dict with seconds,
-    iterations, bounds."""
+    iterations, bounds, and a `metrics_snapshot` in the telemetry
+    exporter's schema (telemetry/metrics.py SNAPSHOT_SCHEMA) with the
+    on-device counter totals — BENCH_*.json entries and live
+    --metrics-snapshot files are directly comparable."""
+    import dataclasses
     import jax
 
     from mpisppy_tpu.algos import fused_wheel as fw
     from mpisppy_tpu.cylinders import hub as hub_mod
     from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    from mpisppy_tpu.telemetry import metrics as metrics_mod
+
+    # on-device kernel counters for the hub's subproblem solves AND the
+    # fused bound planes: a few elementwise ops per 40-iteration restart
+    # window (docs/telemetry.md overhead contract) buys pdhg
+    # iteration/restart/guard totals in the artifact, labeled per
+    # cylinder (cyl="hub"/"lag"/"xhat"/...)
+    ph_opts = dataclasses.replace(
+        ph_opts, pdhg=dataclasses.replace(ph_opts.pdhg, telemetry=True))
+    wheel_opts = wheel_opts or fw.FusedWheelOptions()
+    wheel_opts = dataclasses.replace(
+        wheel_opts,
+        lag_pdhg=dataclasses.replace(wheel_opts.lag_pdhg, telemetry=True),
+        xhat_pdhg=dataclasses.replace(wheel_opts.xhat_pdhg,
+                                      telemetry=True))
 
     ckpt = os.path.abspath(f".bench_ckpt_{label}.npz")
     # checkpoint cadence trades crash-replay time against steady-state
@@ -188,7 +216,7 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
                 "checkpoint_every_s": 120.0}
     hub_opts.update(extra_hub_opts or {})
     opt_kwargs = {"options": ph_opts, "batch": batch,
-                  "wheel_options": wheel_opts or fw.FusedWheelOptions()}
+                  "wheel_options": wheel_opts}
     opt_kwargs.update(extra_opt_kwargs or {})
     hub = {
         "hub_class": hub_mod.PHHub,
@@ -213,6 +241,10 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
         os.remove(ckpt)
     abs_gap, rel_gap = wheel.spcomm.compute_gaps()
     iters = wheel.spcomm._iter
+    # the hub mirrored the cumulative device counters into the global
+    # registry every sync (hub._harvest_kernel_counters); snapshot it
+    # in the exporter's schema (each bench phase is its own process,
+    # so the registry holds exactly this wheel's totals)
     return {
         "label": label,
         "seconds_to_gap": round(elapsed, 3),
@@ -222,6 +254,7 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
         "outer": float(wheel.BestOuterBound),
         "inner": float(wheel.BestInnerBound),
         "resumed_from_checkpoint": resumed,
+        "metrics_snapshot": metrics_mod.REGISTRY.to_snapshot(),
     }
 
 

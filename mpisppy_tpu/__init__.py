@@ -22,6 +22,10 @@ def global_toc(msg: str, cond: bool = True) -> None:
 
     The reference gates on ``rank == 0``; here there is a single
     controller process, so ``cond`` is caller-supplied (default True).
+    Routed through the telemetry console (telemetry/console.py): with
+    no telemetry configured the output format is unchanged; with a
+    configured bus every line also lands in the JSONL trace.
     """
     if cond:
-        print(f"[{_time.time() - _T0:9.2f}] {msg}", flush=True)
+        from mpisppy_tpu.telemetry import console
+        console.log(msg)

@@ -339,6 +339,16 @@ def fused_iter0(batch: ScenarioBatch, rho: Array, opts: ph_mod.PHOptions,
     phst, tb, cert = ph_mod.ph_iter0(batch, rho, opts)
     solver = phst.solver
     dt = batch.qp.c.dtype
+    if solver.counters is not None:
+        # the planes warm-start from the hub's iter0 ITERATES, but
+        # their kernel counters must start at zero — copying the hub's
+        # iter0 totals would inflate every cyl-labeled plane metric by
+        # the full iter0 count (and multi-count it across planes)
+        from mpisppy_tpu.telemetry import counters as _kc
+        solver = dataclasses.replace(
+            solver, counters=_kc.init_counters(
+                solver.omega.shape, dt,
+                ring_size=solver.counters.ring.shape[-1]))
     xhat_solver = dataclasses.replace(
         solver, omega=jnp.full_like(solver.omega, wopts.xhat_pdhg.omega0))
     st = FusedWheelState(

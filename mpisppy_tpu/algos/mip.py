@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.telemetry import console as _console
 from mpisppy_tpu.ops import bnb, pdhg
 from mpisppy_tpu.ops.bnb import BnBOptions
 
@@ -168,7 +169,7 @@ def evaluate_mip_polished(batch: ScenarioBatch, xhat: Array,
         inc, x_inc, feas_s = bnb.merge_incumbents(inc, x_inc, feas_s,
                                                   *ms)
         if verbose:
-            print(f"[polish] multistart merge: {np.asarray(inc)}")
+            _console.log(f"[polish] multistart merge: {np.asarray(inc)}")
     if lns_rounds > 0:
         rep = bnb.lns_repair(qp, batch.d_col, int_cols, x_inc, inc,
                              feas_s, opts, rounds=lns_rounds,
@@ -281,7 +282,8 @@ def first_stage_local_search(batch: ScenarioBatch, xhat0, inner0: float,
         best_val = vals[k]
         best = np.asarray(cands[k], float)
         if verbose:
-            print(f"[ls] round {rnd}: inner -> {best_val:.6g}")
+            _console.log(f"[ls] round {rnd}: inner -> {best_val:.6g}",
+                         level=_console.DEBUG)
     return {"xhat": best, "value": best_val}
 
 
@@ -318,8 +320,9 @@ def mip_dual_ascent_polyak(batch: ScenarioBatch, W, inner: float,
         L = lag["bound"]
         hist.append(L)
         if verbose:
-            print(f"[polyak] step {t}: L = {L:.6g} (best {max(best, L):.6g}"
-                  f", lam {lam:.3g})")
+            _console.log(f"[polyak] step {t}: L = {L:.6g} (best {max(best, L):.6g}"
+                  f", lam {lam:.3g})",
+                         level=_console.DEBUG)
         if L > best:
             best, best_W = L, W
             since = 0
@@ -409,8 +412,9 @@ def mip_dual_bundle(batch: ScenarioBatch, W, inner: float,
         else:
             trust = max(trust * 0.5, 1e-5)
         if verbose:
-            print(f"[bundle] step {t}: L={L:.6g} best={best:.6g} "
-                  f"trust={trust:.3g}")
+            _console.log(f"[bundle] step {t}: L={L:.6g} best={best:.6g} "
+                  f"trust={trust:.3g}",
+                         level=_console.DEBUG)
         if target is not None and best >= target:
             break
         res = lag["result"]
@@ -453,7 +457,7 @@ def mip_dual_bundle(batch: ScenarioBatch, W, inner: float,
                       method="highs")
         if not sol.success:
             if verbose:
-                print(f"[bundle] master failed: {sol.message}")
+                _console.log(f"[bundle] master failed: {sol.message}")
             break
         W_try = sol.x[:nv].reshape(S, N)
         model_val = -sol.fun
@@ -628,8 +632,9 @@ def decomposition_bnb(batch: ScenarioBatch, W,
         if np.isfinite(inner) and nb >= inner - target_gap * scale(inner):
             fathom_floor = min(fathom_floor, nb)
             if verbose:
-                print(f"[ddbnb] node {nodes}: fathomed at {nb:.6g} "
-                      f"(inner {inner:.6g})")
+                _console.log(f"[ddbnb] node {nodes}: fathomed at {nb:.6g} "
+                      f"(inner {inner:.6g})",
+                             level=_console.DEBUG)
             continue
         branchable = hi > lo
         if not bool(np.any(branchable)):
@@ -650,8 +655,9 @@ def decomposition_bnb(batch: ScenarioBatch, W,
         counter += 1
         heapq.heappush(heap, (nb, counter, lo_up, hi))
         if verbose:
-            print(f"[ddbnb] node {nodes}: bound {nb:.6g} inner {inner:.6g} "
-                  f"branch slot {int_slots[j]} at {split}")
+            _console.log(f"[ddbnb] node {nodes}: bound {nb:.6g} inner {inner:.6g} "
+                  f"branch slot {int_slots[j]} at {split}",
+                         level=_console.DEBUG)
 
     open_min = min((b for b, *_ in heap), default=float("inf"))
     outer = min(open_min, fathom_floor, inner)

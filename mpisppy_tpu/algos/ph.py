@@ -35,6 +35,7 @@ import numpy as np
 from mpisppy_tpu import global_toc
 from mpisppy_tpu.core.batch import ScenarioBatch
 from mpisppy_tpu.ops import pdhg
+from mpisppy_tpu.telemetry import profiler as _prof
 
 Array = jax.Array
 
@@ -287,7 +288,8 @@ class PH:
         # _iter0_impl — the hook fires at the reference's point in the
         # sequence (ref:mpisppy/phbase.py:851 after _create_solvers)
         self._ext("iter0_post_solver_creation")
-        self.state, tb, cert = self._iter0_impl()
+        with _prof.annotate("wheel/iter0_solve"):
+            self.state, tb, cert = self._iter0_impl()
         self.trivial_bound = float(tb)
         self.trivial_bound_certified = bool(cert)
         self._ext("post_iter0")
@@ -309,7 +311,8 @@ class PH:
             # so the solve-loop hooks bracket the whole jitted step
             # (ref callout points: mpisppy/phbase.py:1016-1045)
             self._ext("pre_solve_loop")
-            self.state = self._iterk_impl()
+            with _prof.annotate("wheel/subproblem_solve"):
+                self.state = self._iterk_impl()
             self._ext("post_solve_loop")
             conv = self._read_conv()
             self._ext("enditer")

@@ -61,7 +61,8 @@ def main(args=None):
             # evaluating on the candidate's own training scenarios
             # biases the gap estimate LOW and voids the CI coverage
             # guarantee (cf. seqsampling._candidate_seed_span)
-            print("WARNING: neither --start-scen nor --num-scens given; "
+            print(  # telemetry: allow-print (stderr protocol note)
+                "WARNING: neither --start-scen nor --num-scens given; "
                   "gap estimation starts at scenario 0, which likely "
                   "REUSES the scenarios the candidate xhat was fit to "
                   "— the resulting CI is optimistically biased",
@@ -75,7 +76,7 @@ def main(args=None):
         batch_size=int(batch_size),
         start=start)
     res = mmw.run(confidence_level=cfg.get("confidence_level", 0.95))
-    print(json.dumps({k: v for k, v in res.items()}))
+    print(json.dumps({k: v for k, v in res.items()}))  # telemetry: allow-print
     return res
 
 

@@ -26,9 +26,10 @@ import math
 
 import numpy as np
 
-from mpisppy_tpu import global_toc
+from mpisppy_tpu import global_toc, telemetry as tel
 from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator
 from mpisppy_tpu.cylinders.spoke import ConvergerSpokeType
+from mpisppy_tpu.telemetry import profiler as _prof
 
 
 def _checkpoint_crc(data: dict) -> np.ndarray:
@@ -58,7 +59,35 @@ class Hub(SPCommunicator):
         self.latest_ob_char = ""
         self._inner_bound_update_iter = 0
         self._iter = 0
+        # telemetry spine (docs/telemetry.md): every hub observation —
+        # iterations, harvests, bound decisions, checkpoints — is
+        # EMITTED through the event bus; the legacy `trace` list here
+        # and each spoke's `(iter, bound)` trace are subscriber views
+        # (telemetry/views.py), so existing consumers read them
+        # unchanged.  A bus arrives via options['telemetry_bus'] (the
+        # CLI's --trace-jsonl / --metrics-snapshot wiring); otherwise
+        # the hub gets a private sink-less bus whose only subscriber is
+        # the view.
         self.trace: list[dict] = []
+        self.telemetry = self.options.get("telemetry_bus") \
+            or tel.EventBus()
+        self.run_id = tel.new_run_id()
+        self._trace_view = tel.WheelTraceView(self)
+        self.telemetry.subscribe(self._trace_view)
+        self._last_guard_total = 0
+        plan = self.options.get("fault_plan")
+        if plan is not None:
+            # fault injections report through the same spine
+            plan.telemetry = self.telemetry
+            plan.telemetry_run = self.run_id
+        self._profiler = None
+        if self.options.get("profile_dir"):
+            self._profiler = _prof.ProfilerSession(
+                self.options["profile_dir"],
+                num_iters=int(self.options.get("profile_iters", 5)),
+                bus=self.telemetry, run=self.run_id)
+        self._emit(tel.RUN_START, hub_class=type(self).__name__,
+                   num_spokes=len(self.spokes))
         # sense-contradiction bookkeeping (docs/resilience.md): a
         # rejected bound is ambiguous evidence — EITHER the incoming
         # value or the standing opposite-sense incumbent is garbage.
@@ -66,6 +95,11 @@ class Hub(SPCommunicator):
         # contradicted the CURRENT incumbent of `side`; enough of them
         # evict it (see _note_contradiction).
         self._contra: dict[str, list] = {"outer": [], "inner": []}
+
+    def _emit(self, kind: str, _cyl: str = "hub", **data):
+        """Publish one event for this hub's run (no-op without sinks)."""
+        self.telemetry.emit(kind, run=self.run_id, cyl=_cyl,
+                            hub_iter=self._iter, **data)
 
     # -- bound bookkeeping (ref:hub.py:207-243) ---------------------------
     # Non-finite values never enter the bookkeeping: a NaN outer bound
@@ -228,10 +262,15 @@ class PHHub(Hub):
                 sense = "inner"
             else:
                 continue  # cut/rc providers publish no bound
+            self._emit(tel.SPOKE_HARVEST, spoke=j,
+                       spoke_class=type(sp).__name__, sense=sense,
+                       bound=float(b))
             if plan is not None:
                 b = plan.filter_bound(j, sense, float(b), self._iter)
             reason = self._validate_bound(sense, b)
             if reason is not None:
+                self._emit(tel.BOUND_REJECT, spoke=j, sense=sense,
+                           bound=float(b), reason=reason)
                 # scrub the offending value from the spoke's monotone
                 # cache: harvests re-return the cache even with no new
                 # result, so one transient spike would otherwise
@@ -251,10 +290,13 @@ class PHHub(Hub):
             ch = getattr(sp, "converger_spoke_char",
                          type(sp).__name__[0])
             if sense == "outer":
+                before = self.BestOuterBound
                 self.OuterBoundUpdate(b, ch)
+                improved = self.BestOuterBound > before
             else:
                 before = self.BestInnerBound
                 self.InnerBoundUpdate(b, ch)
+                improved = self.BestInnerBound < before
                 # hub-side incumbent cache: BestInnerBound must always
                 # have a backing solution, even after the producing
                 # spoke's cache is later scrubbed or the spoke disabled
@@ -266,7 +308,9 @@ class PHHub(Hub):
             # incumbent: clear the suspicion that had built against it
             other = "inner" if sense == "outer" else "outer"
             self._contra[other] = []
-            sp.trace.append((self._iter, b))
+            # the view appends (iter, bound) to sp.trace (views.py)
+            self._emit(tel.BOUND_ACCEPT, spoke=j, sense=sense,
+                       bound=float(b), char=ch, improved=bool(improved))
 
     def _strike(self, j: int, sp, reason: str, max_strikes: int):
         """One unambiguously-garbage (non-finite) bound = one strike; K
@@ -277,6 +321,9 @@ class PHHub(Hub):
         values from the spoke cache, and the hub's own Best*Bound keeps
         every previously accepted value."""
         sp.strikes = getattr(sp, "strikes", 0) + 1
+        self._emit(tel.SPOKE_STRIKE, spoke=j,
+                   spoke_class=type(sp).__name__, reason=reason,
+                   strikes=sp.strikes, max_strikes=max_strikes)
         global_toc(f"hub: rejected bound from spoke {j} "
                    f"({type(sp).__name__}): {reason} "
                    f"[strike {sp.strikes}/{max_strikes}]",
@@ -284,6 +331,8 @@ class PHHub(Hub):
         if sp.strikes >= max_strikes and not getattr(sp, "disabled",
                                                      False):
             sp.disabled = True
+            self._emit(tel.SPOKE_DISABLE, spoke=j,
+                       spoke_class=type(sp).__name__, strikes=sp.strikes)
             global_toc(f"hub: DISABLED spoke {j} ({type(sp).__name__}) "
                        f"after {sp.strikes} strikes; continuing with "
                        f"the remaining spokes", True)
@@ -319,6 +368,8 @@ class PHHub(Hub):
         next exchange."""
         val = self.BestOuterBound if side == "outer" \
             else self.BestInnerBound
+        self._emit(tel.BOUND_EVICT, side=side, value=float(val),
+                   contradictors=len(contradictors))
         global_toc(f"hub: EVICTING the {side} incumbent ({val:.6g}) — "
                    f"contradicted by {len(contradictors)} distinct "
                    f"spokes", True)
@@ -358,8 +409,19 @@ class PHHub(Hub):
         exchange keeps running across the intervening hub iterations
         (XLA async dispatch), which is exactly the reference's
         slower-cylinder overlap (ref:hub.py write-id freshness checks —
-        a spoke that hasn't produced a new result simply isn't read)."""
+        a spoke that hasn't produced a new result simply isn't read).
+
+        Telemetry (docs/telemetry.md): the wheel phases are bracketed
+        with profiler spans, the --profile-dir session is advanced, and
+        the per-iteration trace row is EMITTED as a hub-iteration event
+        (the legacy self.trace list is a subscriber view)."""
         self._iter += 1
+        if self._profiler is not None:
+            self._profiler.on_sync(self._iter)
+        with _prof.step("wheel_sync", self._iter):
+            self._sync_body()
+
+    def _sync_body(self):
         plan = self.options.get("fault_plan")
         if plan is not None:
             # chaos seams (resilience/faults): a simulated preemption
@@ -376,9 +438,11 @@ class PHHub(Hub):
         fused = [sp for sp in self.spokes if getattr(sp, "fused", False)]
         classic = [sp for sp in self.spokes if not getattr(sp, "fused",
                                                            False)]
-        self._harvest_all(only=fused)
+        with _prof.annotate("wheel/harvest"):
+            self._harvest_all(only=fused)
+            if do_spokes:
+                self._harvest_all(only=classic)
         if do_spokes:
-            self._harvest_all(only=classic)
             # extension exchange with the spokes it cares about
             # (ref:mpisppy/cylinders/hub.py:517-532 drives the
             # extension's sync_with_spokes every sync)
@@ -389,18 +453,21 @@ class PHHub(Hub):
         # building the snapshot dispatches a (small) device gather; with
         # an all-fused wheel no consumer exists, so skip it off-sync
         if (do_spokes and classic) or self.options.get("publish_snapshots"):
-            payload = self._snapshot()
-            self.from_hub.put(payload)  # for API parity / inspection
+            with _prof.annotate("wheel/hub_sync"):
+                payload = self._snapshot()
+                self.from_hub.put(payload)  # for API parity / inspection
             if do_spokes:
-                for sp in classic:
-                    if not getattr(sp, "disabled", False):
-                        sp.update(payload)
-        self._maybe_checkpoint()
+                with _prof.annotate("wheel/spoke_update"):
+                    for sp in classic:
+                        if not getattr(sp, "disabled", False):
+                            sp.update(payload)
+        with _prof.annotate("wheel/checkpoint"):
+            self._maybe_checkpoint()
+        self._harvest_kernel_counters()
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
-        import time as _time
-        self.trace.append({
-            "iter": self._iter, **extra, "t": _time.perf_counter(),
+        self._emit(tel.HUB_ITERATION, **{
+            "iter": self._iter, **extra,
             "outer": self.BestOuterBound, "inner": self.BestInnerBound,
             "abs_gap": abs_gap, "rel_gap": rel_gap,
             "ob_char": self.latest_ob_char, "ib_char": self.latest_ib_char,
@@ -413,6 +480,62 @@ class PHHub(Hub):
                 f" outer {self.BestOuterBound:12.5g}"
                 f" inner {self.BestInnerBound:12.5g} rel_gap {rel_gap:8.3e}"
                 f" ({self.latest_ob_char}/{self.latest_ib_char})", True)
+
+    # -- on-device kernel counter harvest (docs/telemetry.md) -------------
+    def _counter_solvers(self):
+        """(label, PDHGState) pairs carrying kernel counters: the hub's
+        subproblem solver plus any fused bound planes' warm solvers
+        (--kernel-counters arms them all via _fuse_wheel, so they must
+        all be harvested or the exported totals silently undercount)."""
+        out = []
+        st = getattr(self.opt, "state", None)
+        solver = getattr(st, "solver", None) if st is not None else None
+        if solver is not None:
+            out.append(("hub", solver))
+        wstate = getattr(self.opt, "wstate", None)
+        wopts = getattr(self.opt, "wheel_options", None)
+        if wstate is not None and wopts is not None:
+            # gate each plane on ITS options' telemetry flag: plane
+            # states warm-start from the hub's iter0 solver and can
+            # carry a counters pytree their own solve never updates —
+            # harvesting that would report stale iter0 numbers forever
+            plane_on = {
+                "lag": wopts.lag_pdhg.telemetry and wopts.lag_windows,
+                "xhat": wopts.xhat_pdhg.telemetry and wopts.xhat_windows,
+                "slam": wopts.xhat_pdhg.telemetry and wopts.slam_windows,
+                "shuf": wopts.xhat_pdhg.telemetry
+                and wopts.shuffle_windows,
+            }
+            for name, on in plane_on.items():
+                s = getattr(wstate, f"{name}_solver", None)
+                if on and s is not None:
+                    out.append((name, s))
+        return [(cyl, s) for cyl, s in out
+                if getattr(s, "counters", None) is not None]
+
+    def _harvest_kernel_counters(self):
+        """Mirror cumulative on-device counters into the metrics
+        registry and the event stream — one small transfer per solver
+        per sync (the ring stays in HBM), and a strict no-op unless the
+        kernels were built with telemetry=True (counters None
+        otherwise)."""
+        solvers = self._counter_solvers()
+        if not solvers:
+            return
+        from mpisppy_tpu.telemetry import counters as kcounters
+        from mpisppy_tpu.telemetry import metrics as metrics_mod
+        for cyl, solver in solvers:
+            h = kcounters.harvest_state(solver, include_ring=False)
+            kcounters.fold_into_registry(metrics_mod.REGISTRY, h, cyl=cyl)
+            if cyl != "hub":
+                continue
+            guard_total = h["pdhg_guard_resets_total"]
+            if guard_total > self._last_guard_total:
+                self._emit(tel.LANE_QUARANTINE,
+                           resets=guard_total - self._last_guard_total,
+                           total=guard_total)
+            self._last_guard_total = guard_total
+            self._emit(tel.KERNEL_COUNTERS, **h)
 
     # -- crash-resilient checkpointing (VERDICT r3 #2; the analog of the
     # reference surviving solver/license hiccups, ref:spopt.py:931-960) --
@@ -565,6 +688,15 @@ class PHHub(Hub):
                     # losing a WRITE would matter
                     pass
             os.replace(tmp, path)
+        # may run on the background writer daemon: the bus is
+        # thread-safe, and the snapshot's own hub_iter (not the
+        # possibly-advanced live self._iter) stamps the event
+        self.telemetry.emit(
+            tel.CHECKPOINT_WRITE, run=self.run_id, cyl="hub",
+            hub_iter=int(data["hub_iter"]), path=path,
+            bytes=os.path.getsize(path))
+        from mpisppy_tpu.telemetry import metrics as metrics_mod
+        metrics_mod.REGISTRY.inc("checkpoint_writes_total")
         plan = self.options.get("fault_plan")
         if plan is not None:
             plan.on_checkpoint_written(path)
@@ -618,6 +750,8 @@ class PHHub(Hub):
             if cand != path:
                 global_toc(f"checkpoint: {path} invalid, restored the "
                            f"older rotated snapshot {cand}", True)
+            self._emit(tel.CHECKPOINT_RESTORE, path=cand,
+                       fallback=cand != path)
             return extras
         detail = "; ".join(errors) if errors else "no snapshot files"
         raise FileNotFoundError(
@@ -666,6 +800,14 @@ class PHHub(Hub):
         self._trivial_bound_folded = bool(folded)
         if "hub_best_xhat" in data:
             self._best_inner_xhat = np.asarray(data["hub_best_xhat"])
+        # re-baseline the quarantine delta tracker: the restored solver
+        # carries its historical cumulative guard_resets, and without
+        # this the first post-restore sync would emit a spurious
+        # lane-quarantine event re-reporting all past resets as fresh
+        solver = getattr(self.opt.state, "solver", None)
+        if solver is not None:
+            self._last_guard_total = int(
+                np.asarray(solver.guard_resets).sum())
         for j, sp in enumerate(self.spokes):
             key = f"spoke{j}_bound"
             if key in data:
@@ -705,6 +847,12 @@ class PHHub(Hub):
         t = getattr(self, "_ckpt_thread", None)
         if t is not None and t.is_alive():
             t.join()
+        if self._profiler is not None:
+            self._profiler.close()
+        self._harvest_kernel_counters()  # final totals after last iterk
+        abs_gap, rel_gap = self.compute_gaps()
+        self._emit(tel.RUN_END, outer=self.BestOuterBound,
+                   inner=self.BestInnerBound, rel_gap=rel_gap)
         return self.BestInnerBound
 
     def hub_finalize(self):

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu.extensions.extension import Extension
+from mpisppy_tpu.telemetry import console as _console
 
 
 class MinMaxAvg(Extension):
@@ -53,8 +54,8 @@ class MinMaxAvg(Extension):
         if self.opt.state is None:
             return
         avgv, minv, maxv = self.avg_min_max()
-        print(f"  ###  {self.compstr}: avg, min, max, max-min "
-              f"{avgv} {minv} {maxv} {maxv - minv}")
+        _console.log(f"  ###  {self.compstr}: avg, min, max, max-min "
+                     f"{avgv} {minv} {maxv} {maxv - minv}")
 
     def post_iter0(self):
         self._report()

@@ -26,7 +26,16 @@ from mpisppy_tpu.extensions.extension import Extension
 
 
 class TrackedData:
-    """Buffered rows -> csv (ref:phtracker.py:22-101 TrackedData)."""
+    """Buffered rows -> csv (ref:phtracker.py:22-101 TrackedData).
+
+    Flushes go through the shared atomic-write helpers
+    (utils/atomic_io.py): the first flush lands header+rows atomically
+    (tmp + rename — a reader can never see a half-created file), later
+    flushes append each row batch in one write (a crash tears at most
+    the final batch's tail line, and I/O stays O(rows) over the run).
+    Every buffered row is guaranteed to land on the final flush
+    regardless of where the iteration count stopped relative to the
+    save_every*write_every cadence (ISSUE 3 satellite)."""
 
     def __init__(self, name: str, folder: str, plot: bool = False):
         self.name = name
@@ -34,7 +43,7 @@ class TrackedData:
         self.plot_fname = os.path.join(folder, f"{name}.png")
         self.plot = plot
         self.columns: list[str] | None = None
-        self.rows: list[list] = []
+        self.rows: list[list] = []          # buffered, not yet on disk
         self._wrote_header = False
 
     def initialize_df(self, columns):
@@ -46,15 +55,17 @@ class TrackedData:
     def write_out_data(self):
         if self.columns is None:
             return
-        mode = "a" if self._wrote_header else "w"
-        with open(self.fname, mode) as f:
-            if not self._wrote_header:
-                f.write(",".join(map(str, self.columns)) + "\n")
-                self._wrote_header = True
-            for r in self.rows:
-                f.write(",".join(repr(v) if isinstance(v, float)
-                                 else str(v) for v in r) + "\n")
+        from mpisppy_tpu.utils import atomic_io
+        lines = [",".join(repr(v) if isinstance(v, float) else str(v)
+                          for v in r) for r in self.rows]
         self.rows.clear()
+        if not self._wrote_header:
+            header = ",".join(map(str, self.columns))
+            atomic_io.atomic_write_text(
+                self.fname, "\n".join([header] + lines) + "\n")
+            self._wrote_header = True
+        elif lines:
+            atomic_io.append_text(self.fname, "\n".join(lines) + "\n")
 
 
 class PHTracker(Extension):
@@ -102,12 +113,44 @@ class PHTracker(Extension):
         }
         for t, td in self.track_dict.items():
             td.initialize_df(heads[t])
+        self._hub_row: dict | None = None
+        self._subscribed_bus = None
 
     # -- data pulls -------------------------------------------------------
+    # Hub scalars come off the telemetry spine (docs/telemetry.md): the
+    # tracker subscribes to the hub's event bus and its bounds/gaps
+    # rows derive from the SAME hub-iteration events as the JSONL
+    # trace, so the two artifacts cannot diverge.  Tensor tracks
+    # (nonants/duals/xbars/scen_gaps) still pull the device state
+    # directly — they are bulk data no event carries.
+    def _ensure_subscribed(self, hub):
+        bus = getattr(hub, "telemetry", None)
+        if bus is None or bus is self._subscribed_bus:
+            return
+        from mpisppy_tpu import telemetry as tel
+
+        tracker = self
+
+        class _HubRowCache(tel.Sink):
+            def handle(self, event):
+                if event.kind == tel.HUB_ITERATION \
+                        and event.run == hub.run_id:
+                    tracker._hub_row = dict(event.data)
+
+        bus.subscribe(_HubRowCache())
+        self._subscribed_bus = bus
+
     def _bounds(self):
         sp = self.opt.spcomm
         if sp is None:
             return float("nan"), float("nan"), float("nan"), float("nan")
+        self._ensure_subscribed(sp)
+        row = self._hub_row
+        if row is not None:
+            return (row["outer"], row["inner"],
+                    row["abs_gap"], row["rel_gap"])
+        # no hub-iteration event yet (enditer precedes this
+        # iteration's sync): read the bookkeeping directly
         abs_gap, rel_gap = sp.compute_gaps()
         return sp.BestOuterBound, sp.BestInnerBound, abs_gap, rel_gap
 

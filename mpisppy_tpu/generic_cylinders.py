@@ -18,7 +18,7 @@ import importlib
 import json
 import sys
 
-from mpisppy_tpu import global_toc
+from mpisppy_tpu import global_toc, telemetry
 from mpisppy_tpu.core import batch as batch_mod
 from mpisppy_tpu.resilience.faults import PreemptionError
 from mpisppy_tpu.spin_the_wheel import WheelSpinner
@@ -61,6 +61,7 @@ def _parse_args(module, args=None):
     cfg.converger_args()
     cfg.presolve_args()
     cfg.resilience_args()
+    cfg.telemetry_args()
     cfg.wxbar_read_write_args()
     cfg.proper_bundle_config()
     cfg.multistage()
@@ -135,19 +136,26 @@ def _build_batch(cfg, module):
 def _do_EF(cfg, module):
     """ref:generic_cylinders.py:396-457."""
     from mpisppy_tpu.algos import ef as ef_mod
-    names, kwargs, tree = _model_plumbing(cfg, module)
-    ef = ef_mod.ExtensiveForm({"tol": cfg.get("pdhg_tol", 1e-6)},
-                              names, module.scenario_creator, kwargs,
-                              tree=tree)
-    st = ef.solve_extensive_form()
-    obj = ef.get_objective_value()
-    global_toc(f"EF objective: {obj:.6g} "
-               f"(converged={bool(st.done.all())})", True)
-    if cfg.get("solution_base_name"):
-        import numpy as np
-        np.save(cfg["solution_base_name"] + ".npy",
-                np.asarray(list(ef.get_root_solution().values())))
-    print(json.dumps({"EF_objective": obj,
+    # EF runs have no hub to emit wheel events, but --trace-jsonl /
+    # --metrics-snapshot must not be silently ignored: the bus still
+    # captures the console stream and writes a final metrics snapshot
+    tel_bus = telemetry.from_cfg(cfg)
+    try:
+        names, kwargs, tree = _model_plumbing(cfg, module)
+        ef = ef_mod.ExtensiveForm({"tol": cfg.get("pdhg_tol", 1e-6)},
+                                  names, module.scenario_creator, kwargs,
+                                  tree=tree)
+        st = ef.solve_extensive_form()
+        obj = ef.get_objective_value()
+        global_toc(f"EF objective: {obj:.6g} "
+                   f"(converged={bool(st.done.all())})", True)
+        if cfg.get("solution_base_name"):
+            import numpy as np
+            np.save(cfg["solution_base_name"] + ".npy",
+                    np.asarray(list(ef.get_root_solution().values())))
+    finally:
+        telemetry.close_bus(tel_bus)
+    print(json.dumps({"EF_objective": obj,  # telemetry: allow-print
                       "converged": bool(st.done.all())}))
     return ef
 
@@ -193,12 +201,14 @@ def _fuse_wheel(cfg, hub, spokes, specs=None, tree=None):
                                "opt_kwargs": {"options": {}}})
         else:
             out_spokes.append(sd)
-    # --lane-guard must reach the fused planes' PDHG options too, or
-    # the CLI knob would silently guard only the hub's subproblems
+    # --lane-guard and --kernel-counters must reach the fused planes'
+    # PDHG options too, or the CLI knobs would silently cover only the
+    # hub's subproblems
     import dataclasses as _dc
     _defaults = fw.FusedWheelOptions()
     _guard = {"lane_guard": bool(cfg.get("lane_guard", False)),
-              "guard_max_resets": int(cfg.get("guard_max_resets", 3))}
+              "guard_max_resets": int(cfg.get("guard_max_resets", 3)),
+              "telemetry": bool(cfg.get("kernel_counters", False))}
     wopts = fw.FusedWheelOptions(
         lag_pdhg=_dc.replace(_defaults.lag_pdhg, **_guard),
         xhat_pdhg=_dc.replace(_defaults.xhat_pdhg, **_guard),
@@ -336,6 +346,23 @@ def _do_decomp(cfg, module):
         hub, spokes = _fuse_wheel(cfg, hub, spokes, specs=specs,
                                   tree=batch.tree)
 
+    # telemetry spine (docs/telemetry.md): --trace-jsonl /
+    # --metrics-snapshot build the run's event bus; the hub emits into
+    # it and the finally below flushes the sinks even on preemption
+    tel_bus = telemetry.from_cfg(cfg)
+    if tel_bus is not None:
+        hub = dict(hub)
+        hub["hub_kwargs"] = dict(hub.get("hub_kwargs", {}))
+        hub_opts = dict(hub["hub_kwargs"].get("options", {}))
+        hub_opts["telemetry_bus"] = tel_bus
+        hub["hub_kwargs"]["options"] = hub_opts
+    try:
+        return _spin_and_report(cfg, module, hub, spokes, names, specs)
+    finally:
+        telemetry.close_bus(tel_bus)
+
+
+def _spin_and_report(cfg, module, hub, spokes, names, specs):
     wheel = WheelSpinner(hub, spokes)
     ckpt = cfg.get("checkpoint_path")
     if ckpt and cfg.get("checkpoint_restore"):
@@ -360,7 +387,7 @@ def _do_decomp(cfg, module):
         # (--checkpoint-restore picks the run back up)
         global_toc(f"run preempted ({e}); restart with "
                    f"--checkpoint-restore to resume", True)
-        print(json.dumps({"preempted": True,
+        print(json.dumps({"preempted": True,  # telemetry: allow-print
                           "checkpoint_path": ckpt,
                           "iterations": wheel.spcomm._iter}))
         raise SystemExit(75)
@@ -385,7 +412,7 @@ def _do_decomp(cfg, module):
         import math
         return v if isinstance(v, (int, float)) and math.isfinite(v) \
             else None
-    print(json.dumps({
+    print(json.dumps({  # telemetry: allow-print
         "outer_bound": _finite(wheel.BestOuterBound),
         "inner_bound": _finite(wheel.BestInnerBound),
         "abs_gap": _finite(abs_gap), "rel_gap": _finite(rel_gap),

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu.ops import boxqp, pdhg
+from mpisppy_tpu.telemetry import console as _console
 from mpisppy_tpu.ops.boxqp import BoxQP
 
 Array = jax.Array
@@ -885,7 +886,8 @@ def sos1_swap_repair(qp: BoxQP, d_col: Array, int_cols: Array,
         if not bool(np.any(np.asarray(moved))):
             break
         if verbose and (r + 1) % 8 == 0:
-            print(f"[swap] round {r + 1}: obj={np.asarray(obj)}")
+            _console.log(f"[swap] round {r + 1}: obj={np.asarray(obj)}",
+                         level=_console.DEBUG)
     x_orig = x_cur * d_full
     x_orig = x_orig.at[:, int_np].set(xi)
     return (jnp.where(feas_cur, obj, jnp.inf), x_orig, feas_cur)
@@ -1006,7 +1008,8 @@ def lns_repair(qp: BoxQP, d_col: Array, int_cols: Array,
             feas = feas | better
             xi = np.round(best_x[:, int_np])
         if verbose and (r + 1) % 4 == 0:
-            print(f"[lns] round {r + 1}: {best_val}")
+            _console.log(f"[lns] round {r + 1}: {best_val}",
+                         level=_console.DEBUG)
     return (jnp.asarray(np.where(feas, best_val, np.inf), dt),
             jnp.asarray(best_x, dt), jnp.asarray(feas))
 
@@ -1036,7 +1039,7 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
     dive_x, dive_y, omega, Lnorm = warm
     if verbose and bool(np.any(np.asarray(feas))):
         v = np.asarray(inc)
-        print(f"[bnb] dive incumbents: {v}")
+        _console.log(f"[bnb] dive incumbents: {v}")
     if opts.pump_rounds > 0:
         p_val, p_x, p_feas = feasibility_pump(
             qp, d_col, int_cols, opts, rounds=opts.pump_rounds,
@@ -1044,7 +1047,7 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         inc, x_inc, feas = merge_incumbents(inc, x_inc, feas,
                                             p_val, p_x, p_feas)
         if verbose:
-            print(f"[bnb] pump incumbents: {np.asarray(p_val)}")
+            _console.log(f"[bnb] pump incumbents: {np.asarray(p_val)}")
 
     rep = sos1_swap_repair(qp, d_col, int_cols, x_inc, feas, opts,
                            warm=(dive_x, dive_y, omega, Lnorm),
@@ -1052,7 +1055,7 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
     if rep is not None:
         inc, x_inc, feas = merge_incumbents(inc, x_inc, feas, *rep)
         if verbose:
-            print(f"[bnb] swap-repaired incumbents: {np.asarray(inc)}")
+            _console.log(f"[bnb] swap-repaired incumbents: {np.asarray(inc)}")
 
     lo0, hi0 = _root_bounds(qp, d_col, np.asarray(int_cols))
     pool_lo = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
@@ -1080,8 +1083,10 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         if bool(np.all(np.asarray(st.done))):
             break
         if verbose and (r + 1) % 25 == 0:
-            print(f"[bnb] round {r + 1}: inc={np.asarray(st.incumbent)} "
-                  f"outer={np.asarray(st.outer)}")
+            _console.log(f"[bnb] round {r + 1}: "
+                         f"inc={np.asarray(st.incumbent)} "
+                         f"outer={np.asarray(st.outer)}",
+                         level=_console.DEBUG)
 
     # final polish: B&B rounds may have found new incumbents the
     # swap-repair has not seen yet
@@ -1093,6 +1098,17 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         new_inc, new_x, _ = merge_incumbents(
             st.incumbent, st.x_inc, jnp.isfinite(st.incumbent), *rep)
         st = dataclasses.replace(st, incumbent=new_inc, x_inc=new_x)
+
+    # BnB loop telemetry (docs/telemetry.md): the loop already counts
+    # nodes per lane on device (BnBState.nodes_solved); fold this
+    # solve's totals into the process metrics registry so MIP runs
+    # report next to the PDHG counters.  inc (not set): each solve_mip
+    # call contributes its delta to the monotone process total.
+    from mpisppy_tpu.telemetry import metrics as _metrics
+    _metrics.REGISTRY.inc("bnb_nodes_solved_total",
+                          int(np.sum(np.asarray(st.nodes_solved))))
+    _metrics.REGISTRY.inc("bnb_lanes_closed_total",
+                          int(np.sum(np.asarray(st.done))))
 
     inner = st.incumbent
     # A scenario that exhausted its pool with no incumbent and no open

@@ -108,6 +108,15 @@ class PDHGOptions:
     lane_guard: bool = False
     guard_threshold: float = 1e12
     guard_max_resets: int = 3
+    # On-device kernel counters (telemetry subsystem, docs/telemetry.md):
+    # accumulate per-lane iteration/restart/omega-adaptation counts plus
+    # a small KKT-score ring at each restart boundary, inside the jit
+    # graph, harvested host-side in ONE transfer
+    # (telemetry.counters.harvest_state).  False leaves PDHGState.counters
+    # None — zero extra leaves, and the lowered program is byte-identical
+    # to a build that never imported telemetry (tests/test_telemetry.py).
+    telemetry: bool = False
+    telemetry_ring: int = 8   # score samples kept per lane
 
 
 @partial(
@@ -115,7 +124,7 @@ class PDHGOptions:
     data_fields=[
         "x", "y", "x_sum", "y_sum", "x_anchor", "y_anchor",
         "omega", "Lnorm", "k", "nwin", "restart_score", "score", "done",
-        "status", "guard_resets",
+        "status", "guard_resets", "counters",
     ],
     meta_fields=[],
 )
@@ -136,6 +145,10 @@ class PDHGState:
     done: Array     # (...,) bool
     status: Array   # (...,) int32 RUNNING/OPTIMAL/INFEASIBLE/UNBOUNDED
     guard_resets: Array   # (...,) int32 cumulative lane-guard quarantines
+    # telemetry.counters.KernelCounters when opts.telemetry, else None
+    # (None flattens to zero leaves: the off path's pytree and program
+    # are exactly the pre-telemetry ones)
+    counters: object = None
 
 
 def _bshape(p: BoxQP):
@@ -197,7 +210,15 @@ def init_state(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
         done=jnp.zeros(bs, bool),
         status=jnp.zeros(bs, jnp.int32),
         guard_resets=jnp.zeros(bs, jnp.int32),
+        counters=_init_counters(bs, dt, opts),
     )
+
+
+def _init_counters(bs, dt, opts: PDHGOptions):
+    if not opts.telemetry:
+        return None
+    from mpisppy_tpu.telemetry import counters as kcounters
+    return kcounters.init_counters(bs, dt, ring_size=opts.telemetry_ring)
 
 
 def _iter_precision(opts: PDHGOptions):
@@ -383,6 +404,7 @@ def _use_pallas_window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> bool:
 def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
     tau = opts.step_margin * st.omega / st.Lnorm
     sigma = opts.step_margin / (st.omega * st.Lnorm)
+    pre_done, pre_omega = st.done, st.omega
     if _use_pallas_window(p, st, opts):
         from mpisppy_tpu.ops import pdhg_pallas
         interp = jax.default_backend() != "tpu"
@@ -398,6 +420,18 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
             lambda _, s: _pdhg_iter(p, s, tau, sigma, prec), st)
     st = dataclasses.replace(st, nwin=st.nwin + opts.restart_period)
     st = _restart(p, st, opts)
+    if opts.telemetry:
+        # the restart boundary is the harvest point (MPAX, PAPERS.md):
+        # nwin was just incremented by restart_period, so a zero here
+        # means _restart's act mask fired for that lane.  Recorded
+        # BEFORE the lane guard (a quarantine also clears nwin, and is
+        # already counted separately in guard_resets).
+        from mpisppy_tpu.telemetry import counters as kcounters
+        st = dataclasses.replace(st, counters=kcounters.record_window(
+            st.counters, active=~pre_done,
+            restarted=st.nwin == 0,
+            omega_moved=st.omega != pre_omega,
+            score=st.score, period=opts.restart_period))
     if opts.lane_guard:
         st = _lane_guard(p, st, opts)
     return dataclasses.replace(st, k=st.k + opts.restart_period)
@@ -437,6 +471,12 @@ def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
             done=jnp.zeros(state.omega.shape, bool),
             status=jnp.zeros_like(state.status),
         )
+        if opts.telemetry and st.counters is None:
+            # warm state built under telemetry-off options: counters
+            # start at zero from here (totals are per solve lineage)
+            st = dataclasses.replace(
+                st, counters=_init_counters(st.omega.shape, st.x.dtype,
+                                            opts))
 
     # a call is host-level only when NOTHING is traced — a concrete qp
     # with a traced state (vmap/jit over state with a captured problem)
@@ -491,6 +531,9 @@ def solve_fixed(p: BoxQP, n_windows: int, opts: PDHGOptions,
         done=jnp.zeros(state.omega.shape, bool),
         status=jnp.zeros_like(state.status),
     )
+    if opts.telemetry and st.counters is None:
+        st = dataclasses.replace(
+            st, counters=_init_counters(st.omega.shape, st.x.dtype, opts))
     return jax.lax.fori_loop(0, n_windows, lambda _, s: _window(p, s, opts), st)
 
 

@@ -133,6 +133,20 @@ class FaultPlan:
         self._writes = 0
         self._first_seen: dict[int, float] = {}
         self._preempted = False
+        # set by the hub when the plan is armed in its options: every
+        # injection also lands in the telemetry stream as a
+        # fault-injected event (docs/telemetry.md), so a chaos run's
+        # trace shows WHAT was injected next to what the guards did
+        self.telemetry = None
+        self.telemetry_run = ""
+
+    def _fire(self, seam: str, detail: str) -> None:
+        self.fired.append((seam, detail))
+        if self.telemetry is not None:
+            from mpisppy_tpu.telemetry import FAULT_INJECTED
+            self.telemetry.emit(FAULT_INJECTED, run=self.telemetry_run,
+                                cyl="fault-plan", seam=seam,
+                                detail=detail)
 
     @property
     def armed(self) -> bool:
@@ -157,9 +171,8 @@ class FaultPlan:
                     else bound - f.magnitude
             else:  # stale
                 poisoned = self._first_seen.get(spoke_index, bound)
-            self.fired.append(
-                ("spoke_bound",
-                 f"{f.kind} spoke{spoke_index} iter{hub_iter}"))
+            self._fire("spoke_bound",
+                       f"{f.kind} spoke{spoke_index} iter{hub_iter}")
             return poisoned
         return bound
 
@@ -183,8 +196,7 @@ class FaultPlan:
                 nan = jnp.asarray(np.nan, x.dtype)
                 x = x.at[lanes].set(nan)
                 y = y.at[lanes].set(nan)
-            self.fired.append(
-                ("lanes", f"{f.mode} lanes{f.lanes} iter{hub_iter}"))
+            self._fire("lanes", f"{f.mode} lanes{f.lanes} iter{hub_iter}")
         opt.state = dataclasses.replace(
             st, solver=dataclasses.replace(solver, x=x, y=y))
         # FusedPH carries the authoritative state in wstate; keep the
@@ -214,13 +226,13 @@ class FaultPlan:
                     chunk = fh.read(8)
                     fh.seek(off)
                     fh.write(bytes(b ^ 0xFF for b in chunk))
-            self.fired.append(("checkpoint", f"{f.kind} write{idx} {path}"))
+            self._fire("checkpoint", f"{f.kind} write{idx} {path}")
 
     # -- seam: preemption (hub.sync) --------------------------------------
     def maybe_preempt(self, hub_iter: int) -> None:
         if (self.preempt_at_iter is not None and not self._preempted
                 and hub_iter >= self.preempt_at_iter):
             self._preempted = True
-            self.fired.append(("preemption", f"iter{hub_iter}"))
+            self._fire("preemption", f"iter{hub_iter}")
             raise SimulatedPreemption(
                 f"simulated preemption at hub iteration {hub_iter}")

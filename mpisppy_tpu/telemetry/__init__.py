@@ -1,0 +1,69 @@
+###############################################################################
+# mpisppy_tpu.telemetry — the wheel's observability spine
+# (docs/telemetry.md; ISSUE 3).
+#
+#   events    — typed event taxonomy (hub iteration, harvest, bound
+#               accept/reject/strike, checkpoint, fault, quarantine, ...)
+#   bus       — EventBus: thread-safe, failure-isolated fan-out
+#   sinks     — JsonlSink / ConsoleSink / MetricsSnapshotSink
+#   views     — back-compat Hub.trace / Spoke.trace list views
+#   metrics   — MetricsRegistry + the shared snapshot schema (bench.py
+#               embeds the same object in BENCH_*.json)
+#   console   — log(): the replacement for library print(...)
+#   counters  — on-device PDHG kernel counters (imports jax; import the
+#               submodule directly)
+#   profiler  — jax.profiler spans + the --profile-dir session (ditto)
+#
+# This package (minus counters/profiler) imports only the stdlib, so a
+# host-only consumer can read traces without a jax install.
+###############################################################################
+from __future__ import annotations
+
+from mpisppy_tpu.telemetry import console, metrics
+from mpisppy_tpu.telemetry.bus import EventBus
+from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
+    BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT, CHECKPOINT_RESTORE,
+    CHECKPOINT_WRITE, CONSOLE, FAULT_INJECTED, HUB_ITERATION,
+    KERNEL_COUNTERS, LANE_QUARANTINE, PROFILE, RUN_END, RUN_START,
+    SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, Event, new_run_id,
+)
+from mpisppy_tpu.telemetry.sinks import (  # noqa: F401
+    ConsoleSink, JsonlSink, MetricsSnapshotSink, Sink,
+)
+from mpisppy_tpu.telemetry.views import WheelTraceView  # noqa: F401
+
+
+def from_cfg(cfg, registry=None):
+    """Build the run's EventBus from the telemetry_args Config group
+    (utils/config.py).  Returns None when no telemetry output is
+    requested — callers then skip all wiring and the wheel runs the
+    zero-overhead default path.  Always applies --telemetry-verbosity
+    to the console."""
+    verbosity = int(cfg.get("telemetry_verbosity", console.INFO))
+    console.set_verbosity(verbosity)
+    trace_path = cfg.get("trace_jsonl")
+    snap_path = cfg.get("metrics_snapshot")
+    if not trace_path and not snap_path:
+        return None
+    bus = EventBus()
+    if trace_path:
+        bus.subscribe(JsonlSink(trace_path))
+    if snap_path:
+        bus.subscribe(MetricsSnapshotSink(
+            snap_path, registry=registry,
+            every_s=float(cfg.get("metrics_every_s", 30.0))))
+    # the human stream moves onto the bus so stdout and the JSONL trace
+    # can never diverge (telemetry/console.py suppresses its direct
+    # print while a ConsoleSink-bearing bus is attached)
+    bus.subscribe(ConsoleSink(verbosity))
+    console.attach(bus)
+    return bus
+
+
+def close_bus(bus) -> None:
+    """Flush + detach a from_cfg bus (final metrics snapshot, JSONL
+    close).  Safe on None."""
+    if bus is None:
+        return
+    console.detach(bus)
+    bus.close()

@@ -1,0 +1,71 @@
+###############################################################################
+# The library console: every human-readable line mpisppy_tpu produces
+# goes through log() — bare print(...) in library code is a lint error
+# (tools/lint_no_print.py, enforced by a tier-1 test).
+#
+# Behavior:
+#   * With no telemetry configured (the default), log() prints directly
+#     in the classic `[elapsed] msg` global_toc format — byte-for-byte
+#     the pre-telemetry output, so nothing changes for existing users.
+#   * When a bus with a ConsoleSink is attached (telemetry.from_cfg),
+#     the sink renders instead (same format, verbosity-filtered) and
+#     every line ALSO lands in the JSONL trace as a CONSOLE event —
+#     the stdout story and the machine trace can never diverge.
+#
+# Verbosity levels: QUIET(0) errors/final results only, INFO(1) the
+# default progress stream (including verbose-gated milestone lines),
+# DEBUG(2) chatty per-round/per-step diagnostics — the old
+# `verbose=True` round loops in ops/bnb.py and algos/mip.py log at
+# DEBUG, so they need BOTH their verbose flag and verbosity >= 2.
+###############################################################################
+from __future__ import annotations
+
+import sys
+import time
+
+from mpisppy_tpu.telemetry import events as ev
+from mpisppy_tpu.telemetry.sinks import ConsoleSink, DEBUG, INFO, QUIET
+
+__all__ = ["log", "attach", "detach", "set_verbosity",
+           "QUIET", "INFO", "DEBUG"]
+
+_verbosity = INFO
+_attached: list = []  # EventBus instances receiving CONSOLE events
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = int(level)
+
+
+def attach(bus) -> None:
+    if bus not in _attached:
+        _attached.append(bus)
+
+
+def detach(bus) -> None:
+    if bus in _attached:
+        _attached.remove(bus)
+
+
+def _t0() -> float:
+    import mpisppy_tpu
+    return mpisppy_tpu._T0
+
+
+def log(msg: str, level: int = INFO, cyl: str = "",
+        cond: bool = True) -> None:
+    """Emit one console line (and a CONSOLE event to attached buses)."""
+    if not cond:
+        return
+    rendered = False
+    for bus in list(_attached):
+        out = bus.emit(ev.CONSOLE, cyl=cyl, level=level, msg=msg)
+        if out is not None and any(isinstance(s, ConsoleSink)
+                                   for s in bus.sinks):
+            rendered = True
+    if not rendered and level <= _verbosity:
+        # the sink of last resort: identical to the historical
+        # global_toc print format
+        print(f"[{time.time() - _t0():9.2f}] {msg}", file=sys.stdout,
+              flush=True)

@@ -1,0 +1,141 @@
+###############################################################################
+# On-device kernel counters (ISSUE 3 tentpole, part 2; docs/telemetry.md).
+#
+# The PDHG wheel kernel is a lax.while_loop of restart windows — the
+# natural observation point MPAX (PAPERS.md) identifies: restart
+# boundaries are where the solver already touches every lane's
+# bookkeeping, so accumulating a handful of int32 counters and one
+# small score ring there costs a few elementwise ops per ~40-iteration
+# window (<0.1% of the window's matvec work) and NO extra host
+# round-trips.  The whole KernelCounters pytree rides inside PDHGState
+# and is harvested in one small device-to-host transfer
+# (telemetry.counters.harvest_state) whenever the host wants totals —
+# the hub does it once per sync, leaving the per-lane ring in HBM.
+#
+# Overhead contract (asserted by tests/test_telemetry.py): with
+# PDHGOptions.telemetry=False the counters field is None, zero extra
+# leaves enter the jit graph, and the lowered HLO of the PH wheel step
+# is byte-identical to a build that never imported this module.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: score-sample ring slots per lane (one sample per restart window)
+RING_SIZE = 8
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["iters", "restarts", "omega_adapt", "ring", "ring_pos"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class KernelCounters:
+    """Per-lane cumulative counters + a residual-curve sample ring.
+
+    All counters survive warm restarts across PH iterations (solve()'s
+    bookkeeping reset leaves them alone), so totals are per-run."""
+
+    iters: Array        # (...,) int32 PDHG iterations run while active
+    restarts: Array     # (...,) int32 adaptive restarts fired
+    omega_adapt: Array  # (...,) int32 primal-weight adaptations applied
+    ring: Array         # (..., RING) last KKT scores at window boundaries
+    ring_pos: Array     # () int32 total windows observed (write cursor)
+
+
+def init_counters(batch_shape: tuple, dtype,
+                  ring_size: int = RING_SIZE) -> KernelCounters:
+    return KernelCounters(
+        iters=jnp.zeros(batch_shape, jnp.int32),
+        restarts=jnp.zeros(batch_shape, jnp.int32),
+        omega_adapt=jnp.zeros(batch_shape, jnp.int32),
+        ring=jnp.full(batch_shape + (ring_size,), jnp.nan, dtype),
+        ring_pos=jnp.zeros((), jnp.int32),
+    )
+
+
+def record_window(kc: KernelCounters, *, active: Array, restarted: Array,
+                  omega_moved: Array, score: Array,
+                  period: int) -> KernelCounters:
+    """Fold one restart window's observations into the counters
+    (traced; called from ops.pdhg._window only when telemetry is on)."""
+    slot = kc.ring_pos % kc.ring.shape[-1]
+    ring = jax.lax.dynamic_update_slice_in_dim(
+        kc.ring, score[..., None].astype(kc.ring.dtype), slot, axis=-1)
+    return KernelCounters(
+        iters=kc.iters + jnp.where(active, period, 0).astype(jnp.int32),
+        restarts=kc.restarts + (restarted & active).astype(jnp.int32),
+        omega_adapt=kc.omega_adapt + (omega_moved & active).astype(
+            jnp.int32),
+        ring=ring,
+        ring_pos=kc.ring_pos + 1,
+    )
+
+
+# -- host-side harvest -------------------------------------------------------
+def harvest_state(solver_state, include_ring: bool = True) -> dict | None:
+    """Small device-to-host harvest of a PDHGState's counters (plus the
+    lane-guard totals that already live in the state).  Returns None
+    when the state carries no counters (telemetry off).
+
+    include_ring=False is the per-sync hot path: only the LAST ring
+    slot is sliced on device and transferred (the hub needs one score
+    sample for the median gauge) — the full lanes x ring curve stays
+    in HBM until something actually asks for it."""
+    kc = getattr(solver_state, "counters", None)
+    if kc is None:
+        return None
+    import numpy as np
+    ring_size = kc.ring.shape[-1]
+    parts = [kc.iters, kc.restarts, kc.omega_adapt,
+             solver_state.guard_resets, kc.ring_pos]
+    if include_ring:
+        parts.append(kc.ring)
+    else:
+        # slice the newest slot ON DEVICE with the device-resident
+        # cursor; before any window has written, the slot holds the
+        # NaN ring fill and drops out of the median below
+        slot = (kc.ring_pos - 1) % ring_size
+        parts.append(jnp.take(kc.ring, slot, axis=-1))
+    vals = jax.device_get(parts)  # the one blocking transfer
+    iters, restarts, omega, guard = vals[:4]
+    pos = int(vals[4])
+    ring = None
+    if include_ring:
+        ring = np.asarray(vals[5])
+        last = ring[..., (pos - 1) % ring_size] if pos > 0 \
+            else np.full(ring.shape[:-1], np.nan)
+    else:
+        last = np.asarray(vals[5])
+    finite = np.asarray(last)[np.isfinite(np.asarray(last))]
+    out = {
+        "pdhg_iterations_total": int(np.sum(iters)),
+        "pdhg_restarts_total": int(np.sum(restarts)),
+        "pdhg_omega_adaptations_total": int(np.sum(omega)),
+        "pdhg_guard_resets_total": int(np.sum(guard)),
+        "pdhg_windows_total": pos,
+        "pdhg_last_score_median": float(np.median(finite))
+        if finite.size else float("nan"),
+    }
+    if include_ring:
+        out["residual_ring"] = ring
+    return out
+
+
+def fold_into_registry(registry, harvested: dict, cyl: str = "hub"):
+    """Mirror harvested ABSOLUTE totals into a MetricsRegistry (set, not
+    inc — the device counters are cumulative and the source of truth)."""
+    for name in ("pdhg_iterations_total", "pdhg_restarts_total",
+                 "pdhg_omega_adaptations_total",
+                 "pdhg_guard_resets_total", "pdhg_windows_total"):
+        registry.set_counter(name, harvested[name], cyl=cyl)
+    med = harvested["pdhg_last_score_median"]
+    if med == med:  # not NaN
+        registry.set_gauge("pdhg_last_score_median", med, cyl=cyl)

@@ -1,0 +1,112 @@
+###############################################################################
+# Typed telemetry events — the vocabulary of the wheel's one reporting
+# spine (docs/telemetry.md).
+#
+# Every observable thing the wheel does maps to exactly one event kind;
+# sinks (telemetry/sinks.py) and back-compat views (the hub's `trace`
+# list, a spoke's `(iter, bound)` trace) are all subscribers of the same
+# EventBus stream.  An Event is a frozen host-side record: wall-clock
+# AND monotonic timestamps (wall for correlating across machines,
+# monotonic for durations — wall clocks step), a per-bus sequence
+# number (total order even when two events land in the same clock
+# tick), the run id, and the producing cylinder.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import uuid
+from typing import Any
+
+# -- event taxonomy (docs/telemetry.md) -------------------------------------
+HUB_ITERATION = "hub-iteration"        # one hub sync: bounds, gaps, conv
+SPOKE_HARVEST = "spoke-harvest"        # a spoke produced a (raw) bound
+BOUND_ACCEPT = "bound-accept"          # harvested bound passed validation
+BOUND_REJECT = "bound-reject"          # non-finite / sense-violating bound
+SPOKE_STRIKE = "spoke-strike"          # unambiguous garbage charged a strike
+SPOKE_DISABLE = "spoke-disable"        # strike budget exhausted
+BOUND_EVICT = "bound-evict"            # contradicted incumbent evicted
+CHECKPOINT_WRITE = "checkpoint-write"  # a snapshot landed on disk
+CHECKPOINT_RESTORE = "checkpoint-restore"
+FAULT_INJECTED = "fault-injected"      # a FaultPlan seam fired
+LANE_QUARANTINE = "lane-quarantine"    # PDHG lane guard reset lanes
+KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
+CONSOLE = "console"                    # a human-readable log line
+PROFILE = "profile"                    # profiler session start/stop
+RUN_START = "run-start"
+RUN_END = "run-end"
+
+ALL_KINDS = frozenset(v for k, v in list(globals().items())
+                      if k.isupper() and isinstance(v, str))
+
+
+def new_run_id() -> str:
+    """Short unique id correlating every event of one wheel run."""
+    return uuid.uuid4().hex[:12]
+
+
+def _jsonable(v: Any) -> Any:
+    """Best-effort conversion to something json.dumps accepts.  Device
+    scalars/arrays become Python numbers/lists; anything exotic falls
+    back to repr — a trace line must never raise."""
+    if isinstance(v, float):
+        # strict JSON: json.dumps would emit bare Infinity/NaN tokens
+        # that non-Python parsers reject — a bound that never landed
+        # serializes as null (the generic_cylinders _finite convention)
+        import math
+        return v if math.isfinite(v) else None
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    try:  # numpy / jax scalars and arrays
+        import numpy as np
+        if isinstance(v, np.ndarray):
+            return _jsonable(v.tolist())
+        if isinstance(v, np.generic):
+            return _jsonable(v.item())
+        if hasattr(v, "tolist"):  # jax.Array
+            return _jsonable(v.tolist())
+    except Exception:
+        pass
+    return repr(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One telemetry record.  `data` holds the kind-specific payload."""
+
+    kind: str
+    seq: int                 # per-bus monotone sequence number
+    t_wall: float            # time.time()
+    t_mono: float            # time.perf_counter()
+    run: str = ""            # run id (new_run_id())
+    cyl: str = ""            # producing cylinder ("hub", "spoke0:...", ...)
+    hub_iter: int | None = None
+    level: int | None = None  # console verbosity level (CONSOLE only)
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "seq": self.seq,
+             "t_wall": self.t_wall, "t_mono": self.t_mono,
+             "run": self.run, "cyl": self.cyl}
+        if self.hub_iter is not None:
+            d["iter"] = self.hub_iter
+        if self.level is not None:
+            d["level"] = self.level
+        d["data"] = _jsonable(self.data)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+def make_event(kind: str, seq: int, *, run: str = "", cyl: str = "",
+               hub_iter: int | None = None, level: int | None = None,
+               data: dict | None = None) -> Event:
+    return Event(kind=kind, seq=seq, t_wall=time.time(),
+                 t_mono=time.perf_counter(), run=run, cyl=cyl,
+                 hub_iter=hub_iter, level=level, data=data or {})

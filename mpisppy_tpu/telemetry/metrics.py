@@ -1,0 +1,104 @@
+###############################################################################
+# Metrics registry + the shared snapshot schema.
+#
+# A MetricsRegistry is a flat map of named counters (monotone within a
+# run) and gauges (point-in-time values), with optional Prometheus-style
+# labels.  Two render paths share ONE schema:
+#
+#   * render_prom()  — Prometheus text exposition, written atomically to
+#     the --metrics-snapshot file so a node-exporter-style scraper (or a
+#     human with `cat`) can watch a long-running wheel;
+#   * to_snapshot()  — the JSON snapshot dict.  bench.py embeds exactly
+#     this object in BENCH_*.json entries, so offline benchmark
+#     artifacts and live-run snapshots are directly comparable
+#     (ISSUE 3 satellite; see docs/telemetry.md).
+#
+# There is a process-global default registry (REGISTRY) in the style of
+# prometheus_client: deep library code (ops/bnb.py, the hub's kernel
+# harvest) records into it without threading a handle through every
+# call, and sinks snapshot it.  Values mirrored from on-device cumulative
+# counters are SET (absolute), not inc'd — the device is the source of
+# truth and re-folding would double count.
+###############################################################################
+from __future__ import annotations
+
+import threading
+import time
+
+SNAPSHOT_SCHEMA = "mpisppy-tpu-metrics/1"
+
+
+def _key(name: str, labels: dict | None) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge map (checkpoint writes record from a
+    daemon thread)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+
+    # -- recording --------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0, **labels):
+        k = _key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def set_counter(self, name: str, value: float, **labels):
+        """Mirror an absolute cumulative value (e.g. an on-device
+        counter total) into the registry."""
+        with self._lock:
+            self._counters[_key(name, labels)] = float(value)
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._lock:
+            self._gauges[_key(name, labels)] = float(value)
+
+    def get(self, name: str, default: float = 0.0, **labels) -> float:
+        k = _key(name, labels)
+        with self._lock:
+            if k in self._counters:
+                return self._counters[k]
+            return self._gauges.get(k, default)
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+    # -- rendering (the one shared schema) --------------------------------
+    def to_snapshot(self) -> dict:
+        """JSON snapshot — the schema bench.py embeds in BENCH_*.json."""
+        with self._lock:
+            return {
+                "schema": SNAPSHOT_SCHEMA,
+                "t_wall": time.time(),
+                "counters": dict(sorted(self._counters.items())),
+                "gauges": dict(sorted(self._gauges.items())),
+            }
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition (one sample per line)."""
+        snap = self.to_snapshot()
+        lines = [f"# mpisppy-tpu metrics snapshot "
+                 f"(schema {SNAPSHOT_SCHEMA})"]
+        for kind, samples in (("counter", snap["counters"]),
+                              ("gauge", snap["gauges"])):
+            seen_names = set()
+            for k, v in samples.items():
+                base = k.split("{", 1)[0]
+                if base not in seen_names:
+                    seen_names.add(base)
+                    lines.append(f"# TYPE {base} {kind}")
+                lines.append(f"{k} {v!r}")
+        return "\n".join(lines) + "\n"
+
+
+#: process-global default registry (prometheus_client convention)
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,95 @@
+###############################################################################
+# Profiler hooks (ISSUE 3 tentpole, part 3; docs/telemetry.md).
+#
+# Two layers:
+#   * annotate(name) / step(name, n) — thin wrappers over
+#     jax.profiler.TraceAnnotation / StepTraceAnnotation that NEVER
+#     raise (and degrade to no-ops without jax).  The wheel brackets
+#     its phases — hub sync, spoke update, harvest, checkpoint,
+#     subproblem solve — so any externally-started device trace (e.g.
+#     bench.py's jax.profiler.trace) shows named spans instead of an
+#     undifferentiated dispatch soup.  An annotation outside an active
+#     trace is a few ns of host work; nothing enters the jit graph.
+#   * ProfilerSession — the --profile-dir CLI flag: brackets N wheel
+#     iterations with jax.profiler.start_trace/stop_trace, skipping the
+#     compile-heavy first iterations so the trace shows steady state.
+###############################################################################
+from __future__ import annotations
+
+import contextlib
+
+
+def annotate(name: str):
+    """Named host-span context manager (shows as a range in the device
+    trace's host timeline)."""
+    try:
+        import jax.profiler
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def step(name: str, step_num: int):
+    """StepTraceAnnotation: marks one wheel iteration as a training-
+    style 'step' so trace viewers compute per-step statistics."""
+    try:
+        import jax.profiler
+        return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class ProfilerSession:
+    """Bracket wheel iterations [start_iter, start_iter + num_iters)
+    with a jax.profiler trace written to `profile_dir`.
+
+    Driven by the hub: on_sync(hub_iter) every sync, close() at
+    finalize (stops a still-open trace when the wheel terminates before
+    the window completes).  start_iter defaults past Iter0 + the first
+    compiled iterk so steady-state iterations dominate the trace."""
+
+    def __init__(self, profile_dir: str, num_iters: int = 5,
+                 start_iter: int = 3, bus=None, run: str = ""):
+        self.profile_dir = profile_dir
+        self.num_iters = max(1, int(num_iters))
+        self.start_iter = int(start_iter)
+        self.active = False
+        self.failed = False
+        self._bus = bus
+        self._run = run
+
+    def _emit(self, action: str, hub_iter: int | None):
+        if self._bus is not None:
+            from mpisppy_tpu.telemetry import events as ev
+            self._bus.emit(ev.PROFILE, run=self._run, cyl="hub",
+                           hub_iter=hub_iter, action=action,
+                           profile_dir=self.profile_dir)
+
+    def on_sync(self, hub_iter: int) -> None:
+        if self.failed:
+            return
+        try:
+            import jax.profiler
+            if not self.active and hub_iter >= self.start_iter:
+                jax.profiler.start_trace(self.profile_dir)
+                self.active = True
+                self._emit("start", hub_iter)
+            elif self.active \
+                    and hub_iter >= self.start_iter + self.num_iters:
+                jax.profiler.stop_trace()
+                self.active = False
+                self._emit("stop", hub_iter)
+        except Exception:
+            # a broken profiler backend must never kill the run
+            self.failed = True
+            self.active = False
+
+    def close(self) -> None:
+        if self.active:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+                self._emit("stop", None)
+            except Exception:
+                pass
+            self.active = False

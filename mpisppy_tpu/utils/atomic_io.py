@@ -1,0 +1,37 @@
+###############################################################################
+# Atomic text/bytes file writes — the one helper every host-side
+# artifact writer shares (phtracker CSVs, wtracker CSVs, the telemetry
+# metrics snapshot).  Write-to-tmp + os.replace: a reader (or a scraper
+# tailing the metrics file) can never observe a torn half-written file,
+# and a crash mid-write leaves the previous complete version in place.
+# The checkpoint writer in cylinders/hub.py keeps its own rotated
+# variant (it additionally needs multi-slot rotation under a lock).
+###############################################################################
+from __future__ import annotations
+
+import os
+
+
+def atomic_write_bytes(path: str, payload: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode())
+
+
+def append_text(path: str, text: str) -> None:
+    """Append one block in a single os.write on an O_APPEND descriptor:
+    concurrent appenders never interleave mid-block, and a crash can
+    tear at most the final block's tail — the file stays parseable up
+    to it.  The incremental companion to atomic_write_text for growing
+    artifacts (CSV row batches) where full rewrites would cost
+    O(rows^2) I/O over a run."""
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        os.write(fd, text.encode())
+    finally:
+        os.close(fd)

@@ -23,13 +23,16 @@ def _pdhg_opts(cfg) -> pdhg.PDHGOptions:
     return pdhg.PDHGOptions(
         tol=cfg.get("pdhg_tol", 1e-6),
         lane_guard=bool(cfg.get("lane_guard", False)),
-        guard_max_resets=cfg.get("guard_max_resets", 3))
+        guard_max_resets=cfg.get("guard_max_resets", 3),
+        telemetry=bool(cfg.get("kernel_counters", False)))
 
 
 def _hub_opts(cfg) -> dict:
     """Shared hub termination options (ref:hub.py:82-166 inputs) plus
     the resilience knobs (checkpointing / strike policy,
-    docs/resilience.md)."""
+    docs/resilience.md) and the telemetry knobs (profiler session,
+    docs/telemetry.md; the event bus itself is wired by the driver —
+    generic_cylinders builds it once per run via telemetry.from_cfg)."""
     hub_opts = {"rel_gap": cfg.get("rel_gap", 0.01),
                 "display_progress": cfg.get("display_progress", False)}
     if cfg.get("abs_gap") is not None:
@@ -38,7 +41,7 @@ def _hub_opts(cfg) -> dict:
         hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
     for key in ("checkpoint_path", "checkpoint_every_s",
                 "checkpoint_keep", "spoke_max_strikes", "bound_slack",
-                "bound_evict_contras"):
+                "bound_evict_contras", "profile_dir", "profile_iters"):
         if cfg.get(key) is not None:
             hub_opts[key] = cfg[key]
     return hub_opts

@@ -382,6 +382,36 @@ class Config:
                            "bounded quarantine retries per PDHG lane",
                            int, 3)
 
+    def telemetry_args(self):
+        """Telemetry subsystem knobs (docs/telemetry.md): structured
+        wheel tracing, the metrics exporter, on-device kernel counters,
+        and the profiler session.  No reference analog — the reference
+        observes its wheel through per-rank stdout."""
+        self.add_to_config("trace_jsonl",
+                           "write structured wheel events to this JSONL "
+                           "trace file", str, None)
+        self.add_to_config("metrics_snapshot",
+                           "Prometheus-style text metrics file, "
+                           "rewritten atomically during the run", str,
+                           None)
+        self.add_to_config("metrics_every_s",
+                           "seconds between metrics snapshot rewrites",
+                           float, 30.0)
+        self.add_to_config("telemetry_verbosity",
+                           "console verbosity: 0 quiet, 1 progress, "
+                           "2 debug", int, 1)
+        self.add_to_config("kernel_counters",
+                           "accumulate on-device PDHG counters "
+                           "(iterations/restarts/omega adaptations + a "
+                           "score ring) inside the jit graph", bool,
+                           False)
+        self.add_to_config("profile_dir",
+                           "bracket wheel iterations with a "
+                           "jax.profiler trace written here", str, None)
+        self.add_to_config("profile_iters",
+                           "wheel iterations the profiler trace covers",
+                           int, 5)
+
     def checker(self):
         """Cross-option validation (ref:config.py:143-157)."""
         if self.get("smoothed") and self.get("defaultPHp", 0.0) < 0:

@@ -43,13 +43,14 @@ class WTracker:
         return rows
 
     def write_csv(self, fname: str):
+        from mpisppy_tpu.utils.atomic_io import atomic_write_text
         mean, std = self.compute_moving_stats()
-        with open(fname, "w") as f:
-            f.write("scenario,slot,mean,stdev\n")
-            S, N = mean.shape
-            for s in range(S):
-                for i in range(N):
-                    f.write(f"{s},{i},{mean[s, i]},{std[s, i]}\n")
+        lines = ["scenario,slot,mean,stdev"]
+        S, N = mean.shape
+        for s in range(S):
+            for i in range(N):
+                lines.append(f"{s},{i},{mean[s, i]},{std[s, i]}")
+        atomic_write_text(fname, "\n".join(lines) + "\n")
 
 
 class WTrackerExtension:
@@ -75,7 +76,9 @@ class WTrackerExtension:
         self.tracker.grab_local_Ws()
 
     def post_everything(self):
-        from mpisppy_tpu import global_toc
+        from mpisppy_tpu.telemetry import console
         rows = self.tracker.report_by_moving_stats(self.report_thresh)
-        global_toc(f"WTracker: {len(rows)} (scenario, slot) pairs above "
-                   f"stdev {self.report_thresh}", False)
+        # DEBUG level: visible at --telemetry-verbosity 2 (the old code
+        # built the report and then never showed it at all)
+        console.log(f"WTracker: {len(rows)} (scenario, slot) pairs above "
+                    f"stdev {self.report_thresh}", level=console.DEBUG)

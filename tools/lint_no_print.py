@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+###############################################################################
+# No-bare-print lint (ISSUE 3 satellite; enforced in tier-1 by
+# tests/test_telemetry.py::test_no_bare_prints_in_library_code).
+#
+# Library code must report through the telemetry console
+# (mpisppy_tpu.telemetry.console.log) so every human-readable line is
+# verbosity-filtered and lands in the JSONL trace; a bare `print(` is
+# invisible to both.  Allowed exceptions:
+#
+#   * the console/sink implementations themselves,
+#   * __main__ / dryrun entry points (their stdout IS the product),
+#   * lines carrying a `# telemetry: allow-print` marker — the CLI's
+#     machine-readable JSON result protocol on stdout/stderr.
+###############################################################################
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+LIB_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mpisppy_tpu")
+
+ALLOWED_FILES = {
+    "telemetry/console.py",   # the console sink of last resort
+    "telemetry/sinks.py",     # ConsoleSink rendering
+    "__main__.py",            # CLI entry point
+    "parallel/_multihost_dryrun.py",  # multihost smoke entry point
+    "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
+}
+
+MARKER = "telemetry: allow-print"
+PRINT_RE = re.compile(r"(?<![\w.])print\(")
+
+
+def find_violations(root: str = LIB_ROOT) -> list[str]:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel in ALLOWED_FILES:
+                continue
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    # match only the code portion: a print( mentioned in
+                    # a comment (or the allow marker itself) is fine
+                    code = line.split("#", 1)[0]
+                    if PRINT_RE.search(code) and MARKER not in line:
+                        violations.append(
+                            f"{rel}:{lineno}: bare print( — use "
+                            f"mpisppy_tpu.telemetry.console.log "
+                            f"(or add `# {MARKER}` for CLI protocol "
+                            f"output)")
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    for v in violations:
+        print(v)  # the lint tool itself is not library code
+    if violations:
+        print(f"{len(violations)} bare print(s) in library code")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
