@@ -245,6 +245,13 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
     # registry every sync (hub._harvest_kernel_counters); snapshot it
     # in the exporter's schema (each bench phase is its own process,
     # so the registry holds exactly this wheel's totals)
+    # dispatch occupancy/recompile stats ride the artifact next to the
+    # kernel counters (docs/dispatch.md): None when the wheel never
+    # touched the MIP-oracle scheduler, a stats dict (batches, lanes,
+    # occupancy, buckets, backend_compiles, unexpected_recompiles,
+    # inflight_max) otherwise — the dispatch_* counters/gauges inside
+    # metrics_snapshot are the same numbers, mirrored live
+    from mpisppy_tpu import dispatch as dispatch_mod
     return {
         "label": label,
         "seconds_to_gap": round(elapsed, 3),
@@ -255,6 +262,7 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
         "inner": float(wheel.BestInnerBound),
         "resumed_from_checkpoint": resumed,
         "metrics_snapshot": metrics_mod.REGISTRY.to_snapshot(),
+        "dispatch": dispatch_mod.scheduler_stats(),
     }
 
 

@@ -235,9 +235,24 @@ def _tail_rescue(qp, st: pdhg.PDHGState, rp: Array, real: Array,
     scenarios, ~0.7x the hub step, every exchange).  The whole
     sub-solve is lax.cond-gated on some real scenario actually missing
     tolerance, so exchanges whose main pass already cleared the gate
-    pay nothing."""
+    pay nothing.
+
+    k is quantized DOWN the dispatch bucket ladder — the CONFIGURED
+    scheduler's ladder when one exists (--dispatch-bucket-growth
+    governs both the oracle megabatches and these gathers), else the
+    default: the gathered sub-batch is a fresh device shape per
+    distinct k, and without quantization every S (10k sweep, padded
+    variants, multi-model processes) mints its own tail executable —
+    with it, all of them land on a handful of rungs and the jit cache
+    stays bounded (docs/dispatch.md)."""
+    from mpisppy_tpu import dispatch as _dispatch
     S = st.omega.shape[0]
     k = min(wopts.xhat_tail_k, max(8, S // 8), S)
+    if k > 0:
+        sched = _dispatch.get_scheduler(create=False)
+        ladder = sched.ladder if sched is not None \
+            else _dispatch.default_ladder()
+        k = min(ladder.bucket_floor(k), S)
     if k <= 0 or wopts.xhat_tail_windows <= 0:
         return st
 

@@ -80,6 +80,7 @@ class Hub(SPCommunicator):
             # fault injections report through the same spine
             plan.telemetry = self.telemetry
             plan.telemetry_run = self.run_id
+        self._last_dispatch_batches = 0
         self._profiler = None
         if self.options.get("profile_dir"):
             self._profiler = _prof.ProfilerSession(
@@ -464,6 +465,7 @@ class PHHub(Hub):
         with _prof.annotate("wheel/checkpoint"):
             self._maybe_checkpoint()
         self._harvest_kernel_counters()
+        self._harvest_dispatch_stats()
         abs_gap, rel_gap = self.compute_gaps()
         extra = self._trace_extra()
         self._emit(tel.HUB_ITERATION, **{
@@ -536,6 +538,22 @@ class PHHub(Hub):
                            total=guard_total)
             self._last_guard_total = guard_total
             self._emit(tel.KERNEL_COUNTERS, **h)
+
+    # -- dispatch-scheduler stats harvest (docs/dispatch.md) --------------
+    def _harvest_dispatch_stats(self):
+        """One per-sync snapshot of the solve-dispatch scheduler
+        (queue depth, batch occupancy, in-flight, compile counts) onto
+        the event stream.  The scheduler mirrors its gauges into the
+        metrics registry itself; this only adds the per-iteration
+        trace row, and only when dispatches actually happened since
+        the last sync — a wheel that never touches the MIP oracle pays
+        one dict lookup."""
+        from mpisppy_tpu import dispatch as _dispatch
+        stats = _dispatch.scheduler_stats()
+        if not stats or stats["batches"] == self._last_dispatch_batches:
+            return
+        self._last_dispatch_batches = stats["batches"]
+        self._emit(tel.DISPATCH, **stats)
 
     # -- crash-resilient checkpointing (VERDICT r3 #2; the analog of the
     # reference surviving solver/license hiccups, ref:spopt.py:931-960) --

@@ -1,0 +1,157 @@
+###############################################################################
+# Shape buckets: the geometric ladder + batch-axis padding.
+#
+# Every jitted kernel under ops/bnb.py and ops/pdhg.py specializes on
+# the array shapes it is traced with, so a host loop that feeds the
+# device (batch, n, m) triples drawn from a continuum — K*S candidate
+# tilings, k_ws wait-and-see slices, tail-rescue gathers — compiles one
+# executable per distinct triple: a silent recompile storm.  The ladder
+# quantizes the BATCH axis to a small geometric set of rungs; (n, m)
+# stay exact (they are fixed per model family within a run — padding
+# columns/rows would perturb the solve itself).  The number of live
+# executables per kernel is then bounded by
+#     #rungs touched  x  #(n, m) families  x  #option sets,
+# and tests/test_dispatch.py asserts exactly that with a compile
+# counter (compilewatch.py).
+#
+# Padding contract — THE invariant everything downstream leans on: pad
+# lanes are copies of lane 0, and every per-lane computation in the
+# bnb/pdhg stack is independent and deterministic, so a pad lane
+# reproduces lane 0's trajectory and host-side control flow over the
+# whole batch (np.all(done), fixed-count stalls, cycle detection) sees
+# the same truth values padded or not.  In exact arithmetic the
+# sliced-back result would be bit-identical to the unpadded solve; in
+# practice XLA lowers different batch shapes to different (equally
+# valid) instruction schedules, so values match at the ulp level per
+# op — which the B&B's value-driven host heuristics can amplify into
+# small, still-certified value differences (measured ~1e-5 relative on
+# random MIPs; tests/test_dispatch.py pins the band).  Two things are
+# exact either way: every reported bound keeps its certificate, and
+# BnBOptions.jitter > 0 additionally draws shape-keyed randoms (padded
+# solves then take different — equally valid — tie-breaks).  Padded
+# lanes do cost device FLOPs; the ladder keeps that waste under the
+# growth factor.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class BucketLadder:
+    """Geometric batch-size rungs: 1, ceil(g), ceil(g^2), ... (strictly
+    increasing; growth g < 2 still steps by at least 1)."""
+
+    def __init__(self, growth: float = 2.0, min_bucket: int = 1):
+        if growth <= 1.0:
+            raise ValueError(f"bucket growth must exceed 1 ({growth})")
+        self.growth = float(growth)
+        self.min_bucket = max(1, int(min_bucket))
+
+    def rungs(self, up_to: int):
+        """All rungs <= max(up_to, first rung), ascending."""
+        out = [self.min_bucket]
+        while out[-1] < up_to:
+            out.append(max(out[-1] + 1, int(-(-out[-1] * self.growth
+                                              // 1))))
+        return out
+
+    def bucket(self, size: int) -> int:
+        """Smallest rung >= size (the padding target)."""
+        if size <= 0:
+            raise ValueError(f"bucket size must be positive ({size})")
+        r = self.min_bucket
+        while r < size:
+            r = max(r + 1, int(-(-r * self.growth // 1)))
+        return r
+
+    def bucket_floor(self, size: int) -> int:
+        """Largest rung <= size (for sub-batch gathers that must not
+        exceed the source batch)."""
+        if size <= 0:
+            raise ValueError(f"bucket size must be positive ({size})")
+        r = prev = self.min_bucket
+        while r <= size:
+            prev = r
+            r = max(r + 1, int(-(-r * self.growth // 1)))
+        return prev
+
+
+_DEFAULT_LADDER = BucketLadder()
+
+
+def default_ladder() -> BucketLadder:
+    return _DEFAULT_LADDER
+
+
+def _pad_leading(x, batched_ndim: int, pad: int):
+    """Append `pad` copies of row 0 along the leading axis of a field
+    whose batched rank is `batched_ndim`; shared (lower-rank) fields
+    pass through untouched."""
+    if getattr(x, "ndim", 0) != batched_ndim:
+        return x
+    rep = jnp.broadcast_to(x[:1], (pad,) + x.shape[1:])
+    return jnp.concatenate([x, rep], axis=0)
+
+
+def pad_qp_batch(qp, d_col, S_to: int):
+    """Pad a batched BoxQP (and its column scaling) to S_to lanes with
+    copies of lane 0 (see the padding contract in the module header).
+    Returns (qp_padded, d_col_padded); a no-op when already at S_to."""
+    S = qp.c.shape[0]
+    if S_to < S:
+        raise ValueError(f"cannot pad {S} lanes down to {S_to}")
+    if S_to == S:
+        return qp, d_col
+    pad = S_to - S
+    A = qp.A
+    if hasattr(A, "vals"):  # EllMatrix: only a batched vals pads
+        if A.vals.ndim == 3:
+            A = dataclasses.replace(A, vals=_pad_leading(A.vals, 3, pad))
+    else:
+        A = _pad_leading(A, 3, pad)
+    qp2 = dataclasses.replace(
+        qp,
+        c=_pad_leading(qp.c, 2, pad), q=_pad_leading(qp.q, 2, pad),
+        A=A,
+        bl=_pad_leading(qp.bl, 2, pad), bu=_pad_leading(qp.bu, 2, pad),
+        l=_pad_leading(qp.l, 2, pad), u=_pad_leading(qp.u, 2, pad))
+    return qp2, _pad_leading(d_col, 2, pad)
+
+
+def pad_leading_rows(v, S: int, S_to: int):
+    """Pad an auxiliary per-lane array (warm starts etc.) from S to
+    S_to lanes with copies of row 0; non-arrays and arrays without an
+    S-long leading axis pass through untouched."""
+    if getattr(v, "ndim", 0) >= 1 and v.shape[0] == S:
+        rep = jnp.broadcast_to(v[:1], (S_to - S,) + v.shape[1:])
+        return jnp.concatenate([jnp.asarray(v), rep], axis=0)
+    return v
+
+
+def slice_result(res, S: int):
+    """Strip the pad lanes off a result pytree: every leaf with a
+    leading batch axis longer than S is cut back to its first S rows
+    (BnBResult fields are all (S_pad, ...), scalars pass through)."""
+    return jax.tree_util.tree_map(
+        lambda a: a[:S] if (getattr(a, "ndim", 0) >= 1
+                            and a.shape[0] > S) else a, res)
+
+
+def shape_signature(qp, d_col) -> tuple:
+    """The registry key of a dispatch's DEVICE-FACING shape: batch
+    rung, (n, m), dtype, the A storage kind, and which fields carry a
+    batch axis (shared-vs-batched changes the traced program)."""
+    A = qp.A
+    if hasattr(A, "vals"):
+        akind = ("ell", A.k, A.vals.ndim)
+    else:
+        akind = ("dense", A.ndim)
+    batched = tuple(getattr(f, "ndim", 0)
+                    for f in (qp.c, qp.q, qp.bl, qp.bu, qp.l, qp.u,
+                              d_col))
+    return (qp.c.shape[0], qp.n, qp.m, str(qp.c.dtype), akind, batched)

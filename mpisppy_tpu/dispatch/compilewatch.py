@@ -1,0 +1,76 @@
+###############################################################################
+# CompileWatch: the process-wide backend-compile counter.
+#
+# The compile-cache discipline (docs/dispatch.md) is only enforceable
+# if compiles are OBSERVABLE: jax.monitoring emits a
+# '/jax/core/compile/backend_compile_duration' sample every time XLA
+# actually lowers+compiles an executable (cache hits emit nothing), so
+# one registered listener turns the silent recompile storm into a
+# counter the scheduler can attribute to buckets and tests can assert
+# on.  Listener registration is process-global and permanent (JAX has
+# no unregister), so exactly one is ever installed here, guarded by a
+# lock; everything downstream reads deltas of the monotone count.
+###############################################################################
+from __future__ import annotations
+
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_count = 0
+_seconds = 0.0
+
+
+def _listener(name: str, duration: float, **kw) -> None:
+    global _count, _seconds
+    if name == _COMPILE_EVENT:
+        with _lock:
+            _count += 1
+            _seconds += float(duration)
+
+
+def install() -> None:
+    """Idempotently register the one process listener."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+class CompileWatch:
+    """Delta view over the global counter: `with CompileWatch() as w`
+    or manual mark()/delta().  Creating one installs the listener."""
+
+    def __init__(self):
+        install()
+        self._mark = 0
+        self.mark()
+
+    @staticmethod
+    def total() -> int:
+        with _lock:
+            return _count
+
+    @staticmethod
+    def total_seconds() -> float:
+        with _lock:
+            return _seconds
+
+    def mark(self) -> None:
+        self._mark = self.total()
+
+    def delta(self) -> int:
+        """Backend compiles since the last mark()."""
+        return self.total() - self._mark
+
+    def __enter__(self):
+        self.mark()
+        return self
+
+    def __exit__(self, *exc):
+        return False

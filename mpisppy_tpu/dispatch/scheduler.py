@@ -1,0 +1,522 @@
+###############################################################################
+# SolveScheduler: coalescing queue + bounded in-flight dispatch.
+#
+# Every host-driven MIP solve (algos/mip.py oracle loops, ops/bnb.py
+# megabatches, decomposition-B&B node re-solves) routes through one of
+# these instead of calling ops.bnb.solve_mip directly.  Three layers:
+#
+#   * ADMISSION (coalescing windows).  Requests are keyed by their
+#     mergeable identity — (n, m), dtype, A storage/identity, integer
+#     signature, BnBOptions — and same-key requests land in one open
+#     WINDOW.  A window dispatches when it reaches max_batch lanes,
+#     when max_wait_ms passes, or the moment a caller blocks on one of
+#     its tickets (a sync caller never waits out the admission timer
+#     for coalescence that cannot arrive).  Dispatch concatenates the
+#     window's requests along the batch axis into one MEGABATCH solve
+#     and splits the result back per ticket.
+#   * BACKPRESSURE (bounded in-flight).  A semaphore of max_inflight
+#     outstanding dispatches gates every window: when the device
+#     pipeline is full, dispatching threads queue on the semaphore and
+#     their windows KEEP ACCUMULATING requests while they wait — load
+#     turns into batch occupancy instead of tunnel depth, which is the
+#     whole point.  max_inflight=2 is the classic double buffer: one
+#     dispatch executing, one staged.
+#   * SHAPE DISCIPLINE (buckets + compile watch).  Megabatches pad up
+#     the geometric ladder (buckets.py) before dispatch, the padded
+#     shape signature is recorded in the bucket registry, and a
+#     CompileWatch attributes backend compiles: a compile against an
+#     already-warm bucket increments dispatch_unexpected_recompiles
+#     (and raises under --dispatch-compile-guard) instead of silently
+#     storming.
+#
+# Everything is recorded in the process metrics REGISTRY (gauges:
+# queue depth, in-flight, occupancy; counters: batches, lanes, pad
+# lanes, compiles) and, when a bus is attached, emitted as one
+# "dispatch" event per megabatch — see docs/dispatch.md for the field
+# tables.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.dispatch import buckets as _buckets
+from mpisppy_tpu.dispatch import compilewatch as _cw
+from mpisppy_tpu.telemetry import metrics as _metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchOptions:
+    """Scheduler knobs (CLI: the --dispatch-* group, utils/config.py)."""
+
+    coalesce: bool = True        # merge same-key requests into megabatches
+    max_batch: int = 4096        # lane cap per megabatch dispatch
+    max_wait_ms: float = 2.0     # admission window for async submits
+    max_inflight: int = 2        # outstanding dispatches (double buffer)
+    pad_batch: bool = True       # pad megabatches up the bucket ladder
+    bucket_growth: float = 2.0   # geometric ladder growth factor
+    compile_guard: bool = False  # raise on a warm-bucket recompile
+
+
+class SolveTicket:
+    """Future for one submitted solve; result() blocks (and, when the
+    owning window is still open, dispatches it inline — the caller's
+    thread is the natural place to run its own megabatch)."""
+
+    def __init__(self, scheduler, window):
+        self._scheduler = scheduler
+        self._window = window
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self):
+        if not self._event.is_set():
+            self._scheduler._drive(self._window)
+            self._event.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Window:
+    """One open coalescing window for a key: requests accumulate until
+    the window is claimed by a dispatching thread and frozen."""
+
+    __slots__ = ("key", "reqs", "tickets", "t0", "claimed", "frozen")
+
+    def __init__(self, key):
+        self.key = key
+        self.reqs: list = []      # (qp, d_col, int_cols, opts, kwargs)
+        self.tickets: list = []
+        self.t0 = time.perf_counter()
+        self.claimed = False
+        self.frozen = False
+
+
+class SolveScheduler:
+    """See the module header.  `solve_fn` is injectable for tests (a
+    synthetic storm needs to observe concurrency without paying for
+    real branch-and-bound); the default is ops.bnb.solve_mip."""
+
+    def __init__(self, options: DispatchOptions = DispatchOptions(),
+                 solve_fn=None, bus=None, run: str = ""):
+        if solve_fn is None:
+            from mpisppy_tpu.ops import bnb as _bnb
+            solve_fn = _bnb.solve_mip
+        self.options = options
+        self.solve_fn = solve_fn
+        self.bus = bus
+        self.run = run
+        self.ladder = _buckets.BucketLadder(options.bucket_growth)
+        self._lock = threading.Lock()
+        self._sem = threading.Semaphore(max(1, options.max_inflight))
+        self._pending: dict = {}          # key -> open _Window
+        self._watch = _cw.CompileWatch()
+        self._dispatcher = None
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        # -- stats (all also mirrored into the metrics REGISTRY) ----------
+        self._buckets: dict = {}          # shape signature -> dispatches
+        self._inflight = 0
+        self._inflight_max = 0
+        self._batches = 0
+        self._lanes = 0
+        self._pad_lanes = 0
+        self._coalesced_lanes = 0         # lanes that shared a dispatch
+        self._unexpected_recompiles = 0
+        self._dispatch_compiles = 0       # compiles DURING solve windows
+
+    # -- public API -------------------------------------------------------
+    def solve_mip(self, qp, d_col, int_cols, opts=None, **kwargs):
+        """Synchronous solve through the scheduler: bucket-padded, and
+        coalesced with whatever compatible requests are already queued
+        (a lone caller dispatches immediately — the admission timer
+        only ever delays fire-and-forget submits)."""
+        return self.submit(qp, d_col, int_cols, opts, **kwargs).result()
+
+    def submit(self, qp, d_col, int_cols, opts=None,
+               **kwargs) -> SolveTicket:
+        """Enqueue one solve; returns a ticket.  Same-key submits
+        coalesce into one megabatch dispatch.  The caller may submit
+        many and then collect results — the first result() call drives
+        the (single, coalesced) dispatch."""
+        if opts is None:
+            from mpisppy_tpu.ops.bnb import BnBOptions
+            opts = BnBOptions()
+        S = int(qp.c.shape[0])
+        key = self._request_key(qp, d_col, int_cols, opts, kwargs)
+        overflow = None
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            win = self._pending.get(key)
+            lanes = sum(r[0].c.shape[0] for r in win.reqs) if win else 0
+            if (win is None or win.frozen
+                    or not self.options.coalesce
+                    or lanes + S > self.options.max_batch):
+                # a frozen predecessor is already owned by a dispatching
+                # thread; an OPEN one displaced by the lane cap would be
+                # orphaned (the dispatcher only scans _pending) — this
+                # thread dispatches it below, after the lock drops
+                if win is not None and not win.frozen \
+                        and not win.claimed:
+                    overflow = win
+                win = _Window(key)
+                self._pending[key] = win
+            ticket = SolveTicket(self, win)
+            win.reqs.append((qp, d_col, int_cols, opts, kwargs))
+            win.tickets.append(ticket)
+            full = (sum(r[0].c.shape[0] for r in win.reqs)
+                    >= self.options.max_batch)
+            if not full:
+                # the admission-timer daemon covers fire-and-forget
+                # submits whether or not coalescing is on
+                self._ensure_dispatcher()
+            self._wake.notify_all()
+        if overflow is not None:
+            self._drive(overflow)
+        if full:
+            self._drive(win)
+        return ticket
+
+    def stats(self) -> dict:
+        """Point-in-time snapshot for bench artifacts and the hub's
+        per-sync telemetry (docs/dispatch.md field table)."""
+        with self._lock:
+            lanes = max(1, self._lanes + self._pad_lanes)
+            return {
+                "batches": self._batches,
+                "lanes": self._lanes,
+                "pad_lanes": self._pad_lanes,
+                "coalesced_lanes": self._coalesced_lanes,
+                "occupancy": self._lanes / lanes,
+                "buckets": len(self._buckets),
+                # compiles observed WHILE a dispatch executed — the
+                # dispatch-attributable count (other threads' compiles
+                # can land in the window; see _solve_merged's caveat).
+                # The raw process total is CompileWatch.total().
+                "backend_compiles": self._dispatch_compiles,
+                "unexpected_recompiles": self._unexpected_recompiles,
+                "inflight_max": self._inflight_max,
+                "queue_depth": sum(len(w.reqs)
+                                   for w in self._pending.values()),
+            }
+
+    def close(self):
+        """Flush every open window and stop the dispatcher thread."""
+        with self._lock:
+            self._closed = True
+            wins = [w for w in self._pending.values() if not w.claimed]
+            self._wake.notify_all()
+        for w in wins:
+            self._drive(w)
+        t = self._dispatcher
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    # -- request identity -------------------------------------------------
+    def _request_key(self, qp, d_col, int_cols, opts, kwargs) -> tuple:
+        """Mergeable identity.  Batched per-lane fields concatenate
+        freely; SHARED structure (a broadcast A, the ELL column index
+        array, a ConeSpec) must be the same object across a window —
+        object identity is exact for the oracle loops, which rebuild
+        c/l/u per call but thread the same A through (see
+        mip.lagrangian_mip_bound), and a miss only costs coalescence,
+        never correctness.  Requests with kwargs never coalesce (a
+        warm-start array is per-request state)."""
+        A = qp.A
+        if hasattr(A, "vals"):
+            a_id = ("ell", id(A.cols),
+                    None if A.vals.ndim == 3 else id(A.vals))
+        else:
+            a_id = ("dense", None if A.ndim == 3 else id(A))
+        cones = getattr(qp, "cones", None)
+        shared = tuple(
+            None if getattr(f, "ndim", 0) == nd else id(f)
+            for f, nd in ((qp.c, 2), (qp.q, 2), (qp.bl, 2), (qp.bu, 2),
+                          (qp.l, 2), (qp.u, 2), (d_col, 2)))
+        ints = np.asarray(int_cols)
+        return (qp.n, qp.m, str(qp.c.dtype), a_id, shared,
+                None if cones is None else id(cones),
+                ints.shape, hash(ints.tobytes()), opts,
+                ("solo", id(kwargs)) if kwargs else ())
+
+    # -- dispatch machinery -----------------------------------------------
+    def _ensure_dispatcher(self):
+        """Lazy daemon that fires windows whose admission timer lapsed
+        (callers that block in result() drive their own windows; this
+        thread only covers fire-and-forget submits).  Caller holds the
+        lock."""
+        if self._dispatcher is not None and self._dispatcher.is_alive():
+            return
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="mpisppy-tpu-dispatch")
+        self._dispatcher.start()
+
+    def _dispatch_loop(self):
+        wait_s = max(self.options.max_wait_ms, 0.1) / 1e3
+        while True:
+            with self._lock:
+                now = time.perf_counter()
+                open_w = [w for w in self._pending.values()
+                          if not w.claimed]
+                due = [w for w in open_w if now - w.t0 >= wait_s]
+                if not due:
+                    if self._closed:
+                        return
+                    if open_w:
+                        # sleep exactly to the earliest admission
+                        # deadline
+                        deadline = min(w.t0 + wait_s for w in open_w)
+                        self._wake.wait(timeout=max(deadline - now,
+                                                    1e-4))
+                    else:
+                        # idle: block until a submit (or close)
+                        # notifies — no polling
+                        self._wake.wait()
+                    continue
+            for w in due:
+                self._drive(w)
+
+    def _drive(self, win: _Window):
+        """Claim-and-run a window; loses the race gracefully when
+        another thread (or the dispatcher) got there first."""
+        with self._lock:
+            if win.claimed:
+                return
+            win.claimed = True
+        try:
+            self._run_window(win)
+        except BaseException as e:  # noqa: BLE001 — fanned out below
+            with self._lock:
+                win.frozen = True
+                if self._pending.get(win.key) is win:
+                    del self._pending[win.key]
+            for t in win.tickets:
+                if not t.done():
+                    t._exc = e
+                    t._event.set()
+            raise
+
+    def _run_window(self, win: _Window):
+        # backpressure FIRST: while this thread queues on the in-flight
+        # semaphore the window is still open, so a storm accumulates
+        # into occupancy rather than tunnel depth
+        self._sem.acquire()
+        try:
+            with self._lock:
+                win.frozen = True
+                if self._pending.get(win.key) is win:
+                    del self._pending[win.key]
+                reqs = list(win.reqs)
+                tickets = list(win.tickets)
+                self._inflight += 1
+                self._inflight_max = max(self._inflight_max,
+                                         self._inflight)
+                _metrics.REGISTRY.set_gauge("dispatch_inflight",
+                                            self._inflight)
+            t_launch = time.perf_counter()
+            res, sizes, S_pad, sig = self._solve_merged(reqs)
+            off = 0
+            for t, S in zip(tickets, sizes):
+                # per-request slices exclude the pad lanes automatically
+                # (pads sit past the last real lane)
+                t._result = jax.tree_util.tree_map(
+                    lambda a, o=off, s=S: a[o:o + s]
+                    if getattr(a, "ndim", 0) >= 1 else a, res)
+                t._event.set()
+                off += S
+            self._record(win, sizes, S_pad, sig, t_launch)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                _metrics.REGISTRY.set_gauge("dispatch_inflight",
+                                            self._inflight)
+            self._sem.release()
+
+    def _solve_merged(self, reqs):
+        """Concatenate the window's requests, pad up the ladder, solve.
+        Returns (result, per-request sizes, padded lane count, shape
+        signature)."""
+        qps = [r[0] for r in reqs]
+        sizes = [int(q.c.shape[0]) for q in qps]
+        S_tot = sum(sizes)
+        qp, d_col = self._merge(reqs) if len(reqs) > 1 \
+            else (reqs[0][0], reqs[0][1])
+        int_cols, opts, kwargs = reqs[0][2], reqs[0][3], reqs[0][4]
+        S_pad = self.ladder.bucket(S_tot) if self.options.pad_batch \
+            else S_tot
+        S_pad = max(S_pad, S_tot)
+        qp, d_col = _buckets.pad_qp_batch(qp, d_col, S_pad)
+        if S_pad > S_tot and kwargs:
+            # per-lane kwargs (x_warm/y_warm) must ride the same
+            # padding or their lane count no longer matches the qp's
+            kwargs = {
+                k: _buckets.pad_leading_rows(v, S_tot, S_pad)
+                for k, v in kwargs.items()}
+        sig = _buckets.shape_signature(qp, d_col) + (opts,)
+        warm = sig in self._buckets
+        before = self._watch.total()
+        res = self.solve_fn(qp, d_col, int_cols, opts, **kwargs)
+        compiled = self._watch.total() - before
+        self._dispatch_compiles += compiled
+        if warm and compiled and self._inflight == 1:
+            # ADVISORY attribution: the counter is only read with one
+            # dispatch in flight, but compiles from OTHER threads (a
+            # hub step compiling a wheel kernel) and legitimately
+            # value-derived shapes inside a bucket (detect_sos1_groups'
+            # (G, L) arrays follow A's VALUES, not its shape) can still
+            # land in the window.  That is why the default only counts;
+            # compile_guard is the strict dev/test mode that turns the
+            # count into an assertion on workloads known to be clean.
+            self._unexpected_recompiles += compiled
+            _metrics.REGISTRY.inc("dispatch_unexpected_recompiles_total",
+                                  compiled)
+            if self.options.compile_guard:
+                raise AssertionError(
+                    f"compile-cache discipline violated: {compiled} "
+                    f"backend compile(s) against warm bucket {sig[:3]} "
+                    "(if this workload legitimately varies value-"
+                    "derived kernel shapes inside a bucket, run "
+                    "without --dispatch-compile-guard)")
+        with self._lock:
+            self._buckets[sig] = self._buckets.get(sig, 0) + 1
+        return res, sizes, S_pad, sig
+
+    def _merge(self, reqs):
+        """One megabatch BoxQP from same-key requests: batched fields
+        concatenate along the lane axis, shared fields (same object by
+        key construction) pass through; a field shared in one request
+        but batched in another broadcasts before the concat."""
+        qps = [r[0] for r in reqs]
+        d_cols = [r[1] for r in reqs]
+        sizes = [int(q.c.shape[0]) for q in qps]
+
+        def cat(fields, batched_ndim):
+            if all(getattr(f, "ndim", 0) < batched_ndim
+                   for f in fields) and \
+                    all(f is fields[0] for f in fields):
+                return fields[0]
+            return jnp.concatenate(
+                [jnp.broadcast_to(f, (s,) + f.shape[-(batched_ndim - 1):])
+                 if f.ndim < batched_ndim else f
+                 for f, s in zip(fields, sizes)], axis=0)
+
+        A0 = qps[0].A
+        if hasattr(A0, "vals"):
+            if A0.vals.ndim == 3:
+                A = dataclasses.replace(
+                    A0, vals=jnp.concatenate([q.A.vals for q in qps],
+                                             axis=0))
+            else:
+                A = A0  # shared vals/cols: key guarantees identity
+        elif A0.ndim == 3:
+            A = jnp.concatenate([q.A for q in qps], axis=0)
+        else:
+            A = A0      # shared dense A: key guarantees identity
+        qp = dataclasses.replace(
+            qps[0],
+            c=cat([q.c for q in qps], 2), q=cat([q.q for q in qps], 2),
+            A=A,
+            bl=cat([q.bl for q in qps], 2), bu=cat([q.bu for q in qps], 2),
+            l=cat([q.l for q in qps], 2), u=cat([q.u for q in qps], 2))
+        return qp, cat(d_cols, 2)
+
+    def _record(self, win: _Window, sizes, S_pad: int, sig,
+                t_launch: float):
+        real = sum(sizes)
+        occ = real / max(1, S_pad)
+        with self._lock:
+            self._batches += 1
+            self._lanes += real
+            self._pad_lanes += S_pad - real
+            if len(sizes) > 1:
+                self._coalesced_lanes += real
+            queue_depth = sum(len(w.reqs) for w in self._pending.values())
+        R = _metrics.REGISTRY
+        R.inc("dispatch_batches_total")
+        R.inc("dispatch_lanes_total", real)
+        R.inc("dispatch_pad_lanes_total", S_pad - real)
+        R.set_gauge("dispatch_batch_occupancy", occ)
+        R.set_gauge("dispatch_queue_depth", queue_depth)
+        R.set_gauge("dispatch_buckets_active", len(self._buckets))
+        R.set_counter("dispatch_backend_compiles_total",
+                      self._dispatch_compiles)
+        if self.bus is not None:
+            from mpisppy_tpu import telemetry as tel
+            self.bus.emit(
+                tel.DISPATCH, run=self.run, cyl="dispatch",
+                requests=len(sizes), lanes=real, padded_to=S_pad,
+                occupancy=occ, bucket=list(sig[:3]),
+                wait_ms=1e3 * (t_launch - win.t0),
+                queue_depth=queue_depth,
+                inflight_max=self._inflight_max)
+
+
+# -- the process-default scheduler (prometheus_client-style global) ---------
+_default_lock = threading.Lock()
+_default: SolveScheduler | None = None
+
+
+def get_scheduler(create: bool = True) -> SolveScheduler | None:
+    """The process-default scheduler every library call site routes
+    through; created lazily with default options on first use."""
+    global _default
+    with _default_lock:
+        if _default is None and create:
+            _default = SolveScheduler()
+        return _default
+
+
+def configure(options: DispatchOptions | None = None, bus=None,
+              run: str = "") -> SolveScheduler:
+    """(Re)build the process-default scheduler — the CLI wiring seam
+    (generic_cylinders calls this off the --dispatch-* group).  Any
+    previous default is flushed first."""
+    global _default
+    with _default_lock:
+        old, _default = _default, None
+    if old is not None:
+        old.close()
+    sched = SolveScheduler(options or DispatchOptions(), bus=bus, run=run)
+    with _default_lock:
+        _default = sched
+    return sched
+
+
+def from_cfg(cfg, bus=None, run: str = "") -> SolveScheduler:
+    """Build + install the default scheduler from the dispatch_args
+    Config group (utils/config.py)."""
+    return configure(DispatchOptions(
+        coalesce=bool(cfg.get("dispatch_coalesce", True)),
+        max_batch=int(cfg.get("dispatch_max_batch", 4096)),
+        max_wait_ms=float(cfg.get("dispatch_max_wait_ms", 2.0)),
+        max_inflight=int(cfg.get("dispatch_max_inflight", 2)),
+        pad_batch=bool(cfg.get("dispatch_pad", True)),
+        bucket_growth=float(cfg.get("dispatch_bucket_growth", 2.0)),
+        compile_guard=bool(cfg.get("dispatch_compile_guard", False)),
+    ), bus=bus, run=run)
+
+
+def solve_mip(qp, d_col, int_cols, opts=None, **kwargs):
+    """Module-level convenience: one solve through the process-default
+    scheduler (the drop-in for ops.bnb.solve_mip at every oracle call
+    site — algos/mip.py routes here)."""
+    return get_scheduler().solve_mip(qp, d_col, int_cols, opts, **kwargs)
+
+
+def scheduler_stats() -> dict | None:
+    """stats() of the default scheduler, None when none exists yet —
+    bench.py embeds this in its artifact entries."""
+    sched = get_scheduler(create=False)
+    return None if sched is None else sched.stats()

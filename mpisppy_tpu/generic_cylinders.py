@@ -62,6 +62,7 @@ def _parse_args(module, args=None):
     cfg.presolve_args()
     cfg.resilience_args()
     cfg.telemetry_args()
+    cfg.dispatch_args()
     cfg.wxbar_read_write_args()
     cfg.proper_bundle_config()
     cfg.multistage()
@@ -350,6 +351,12 @@ def _do_decomp(cfg, module):
     # --metrics-snapshot build the run's event bus; the hub emits into
     # it and the finally below flushes the sinks even on preemption
     tel_bus = telemetry.from_cfg(cfg)
+    # dispatch scheduler (docs/dispatch.md): the --dispatch-* group
+    # configures the process-default scheduler every MIP-oracle solve
+    # routes through; with a bus attached each megabatch dispatch also
+    # lands in the JSONL trace
+    from mpisppy_tpu import dispatch as _dispatch
+    _dispatch.from_cfg(cfg, bus=tel_bus)
     if tel_bus is not None:
         hub = dict(hub)
         hub["hub_kwargs"] = dict(hub.get("hub_kwargs", {}))

@@ -232,6 +232,16 @@ def _solve_node(qp_node: BoxQP, x_warm: Array, y_warm: Array,
     return sol, obj, lb, rp
 
 
+# _solve_node for HOST-LOOP call sites (feasibility_pump's root/pin
+# evaluations, sos1_swap_repair's baseline solve).  Called eagerly, the
+# pdhg while_loop would close over the QP's VALUES as jaxpr constants
+# and XLA would compile a fresh `while` executable per call — ~2 silent
+# recompiles per pump round, found by the dispatch compile guard
+# (docs/dispatch.md).  The jit keys on shapes + the static opts instead.
+_solve_node_jit = partial(jax.jit,
+                          static_argnames=("lp_opts", "jitter"))(_solve_node)
+
+
 @partial(jax.jit, static_argnames=("opts",))
 def bnb_round(qp: BoxQP, d_col: Array, int_cols: Array, st: BnBState,
               opts: BnBOptions) -> BnBState:
@@ -446,7 +456,8 @@ def feasibility_pump(qp: BoxQP, d_col: Array, int_cols: Array,
     hi0 = jnp.asarray(hi0, dt)
     # root LP under the true objective seeds the rounding
     qpr = _node_qp(qp, d_col, int_cols, lo0, hi0)
-    sol, _, _, _ = _solve_node(qpr, x_warm, y_warm, opts.lp, omega, Lnorm)
+    sol, _, _, _ = _solve_node_jit(qpr, x_warm, y_warm, opts.lp, omega,
+                                   Lnorm)
     x_warm, y_warm, omega = sol.x, sol.y, sol.omega
     xi = (sol.x * jnp.broadcast_to(d_col, sol.x.shape))[:, int_cols]
     xint = jnp.clip(jnp.floor(xi + 0.5), lo0, hi0)
@@ -465,8 +476,8 @@ def feasibility_pump(qp: BoxQP, d_col: Array, int_cols: Array,
         # evaluate the CURRENT rounding: ONE true-objective solve of the
         # fully pinned LP
         qp_pin = _node_qp(qp, d_col, int_cols, new_xint, new_xint)
-        psol, pobj, _, prp = _solve_node(qp_pin, x_warm, y_warm, opts.lp,
-                                         omega, Lnorm)
+        psol, pobj, _, prp = _solve_node_jit(qp_pin, x_warm, y_warm,
+                                             opts.lp, omega, Lnorm)
         p_feas = (prp <= opts.feas_tol) \
             & (psol.status != pdhg.INFEASIBLE) \
             & (psol.status != pdhg.UNBOUNDED)
@@ -873,7 +884,8 @@ def sos1_swap_repair(qp: BoxQP, d_col: Array, int_cols: Array,
     # evaluate the incumbents once (all integers fixed) for the
     # baseline objective and duals the first proposals read
     qpn = _node_qp(qp, d_col, int_cols, xi, xi)
-    sol, obj, _, rp = _solve_node(qpn, x_w, y_w, opts.lp, omega, Lnorm)
+    sol, obj, _, rp = _solve_node_jit(qpn, x_w, y_w, opts.lp, omega,
+                                      Lnorm)
     feas_cur = jnp.asarray(feas) & (rp <= opts.feas_tol) \
         & (sol.status != pdhg.INFEASIBLE) \
         & (sol.status != pdhg.UNBOUNDED)

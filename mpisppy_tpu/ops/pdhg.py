@@ -156,6 +156,7 @@ def _bshape(p: BoxQP):
     return p.c.shape[:-1]
 
 
+@partial(jax.jit, static_argnames=("iters",))
 def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
     """Power iteration for ||A||_2, batch-aware.
 
@@ -165,7 +166,12 @@ def estimate_norm(p: BoxQP, iters: int = 30) -> Array:
     the max row/column 2-norms, both guaranteed lower bounds on ||A||_2,
     so a degenerate iterate can never produce an underestimate that makes
     tau explode.
-    """
+
+    Jitted (shape-keyed): called eagerly, the fori_loop would otherwise
+    close over p's VALUES as jaxpr constants and XLA would compile a
+    fresh scan executable for every distinct QP — one silent recompile
+    per solve_mip/dive call (found by the dispatch compile guard,
+    docs/dispatch.md)."""
     v = jax.random.normal(jax.random.PRNGKey(7), p.c.shape, p.c.dtype)
     v = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
 

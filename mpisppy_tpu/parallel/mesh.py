@@ -46,8 +46,19 @@ def init_multihost(coordinator_address: str,
 
     if cpu_devices_per_process is not None:
         _jax.config.update("jax_platforms", "cpu")
-        _jax.config.update("jax_num_cpu_devices",
-                           int(cpu_devices_per_process))
+        try:
+            _jax.config.update("jax_num_cpu_devices",
+                               int(cpu_devices_per_process))
+        except AttributeError:
+            # older JAX spells the knob as an XLA flag, read when the
+            # backend initializes (distributed.initialize below
+            # triggers that, so setting the env var here still works)
+            import os
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{int(cpu_devices_per_process)}").strip()
         _jax.config.update("jax_cpu_collectives_implementation", "gloo")
     _jax.distributed.initialize(coordinator_address=coordinator_address,
                                 num_processes=num_processes,

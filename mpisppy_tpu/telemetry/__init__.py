@@ -23,7 +23,7 @@ from mpisppy_tpu.telemetry import console, metrics
 from mpisppy_tpu.telemetry.bus import EventBus
 from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT, CHECKPOINT_RESTORE,
-    CHECKPOINT_WRITE, CONSOLE, FAULT_INJECTED, HUB_ITERATION,
+    CHECKPOINT_WRITE, CONSOLE, DISPATCH, FAULT_INJECTED, HUB_ITERATION,
     KERNEL_COUNTERS, LANE_QUARANTINE, PROFILE, RUN_END, RUN_START,
     SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, Event, new_run_id,
 )

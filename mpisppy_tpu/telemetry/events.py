@@ -31,6 +31,7 @@ CHECKPOINT_WRITE = "checkpoint-write"  # a snapshot landed on disk
 CHECKPOINT_RESTORE = "checkpoint-restore"
 FAULT_INJECTED = "fault-injected"      # a FaultPlan seam fired
 LANE_QUARANTINE = "lane-quarantine"    # PDHG lane guard reset lanes
+DISPATCH = "dispatch"                  # one coalesced megabatch dispatched
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler session start/stop

@@ -412,6 +412,36 @@ class Config:
                            "wheel iterations the profiler trace covers",
                            int, 5)
 
+    def dispatch_args(self):
+        """Dispatch-scheduler knobs (docs/dispatch.md): the coalescing
+        queue, the bounded in-flight pipeline, and the shape-bucket /
+        compile-cache discipline every host-driven MIP solve rides
+        through.  No reference analog — each reference subproblem is
+        one opaque Gurobi call on its own rank (ref:mpisppy/
+        spopt.py:884); batching/queueing is the TPU wheel's problem."""
+        self.add_to_config("dispatch_coalesce",
+                           "aggregate concurrent same-shape solves "
+                           "into megabatch dispatches", bool, True)
+        self.add_to_config("dispatch_max_batch",
+                           "lane cap per coalesced megabatch dispatch",
+                           int, 4096)
+        self.add_to_config("dispatch_max_wait_ms",
+                           "admission window (ms) a queued solve may "
+                           "wait for coalescence", float, 2.0)
+        self.add_to_config("dispatch_max_inflight",
+                           "outstanding device dispatches before "
+                           "submitters block (2 = double buffer)",
+                           int, 2)
+        self.add_to_config("dispatch_pad",
+                           "pad megabatches up the geometric bucket "
+                           "ladder (bounded jit cache)", bool, True)
+        self.add_to_config("dispatch_bucket_growth",
+                           "geometric growth factor of the batch "
+                           "bucket ladder", float, 2.0)
+        self.add_to_config("dispatch_compile_guard",
+                           "raise on a backend compile against an "
+                           "already-warm shape bucket", bool, False)
+
     def checker(self):
         """Cross-option validation (ref:config.py:143-157)."""
         if self.get("smoothed") and self.get("defaultPHp", 0.0) < 0:

@@ -108,7 +108,11 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
                                                 drv.state.xbar_nodes[0])),
              np.asarray(xhat_mod.slam_candidate(batch, x_non, True)),
              np.asarray(xhat_mod.slam_candidate(batch, x_non, False))]
-    ws = bnb.solve_mip(batch_inner.qp, batch_inner.d_col, np.nonzero(
+    # through the dispatch scheduler (docs/dispatch.md) like every
+    # other oracle call in this driver: bucket-padded shapes + the
+    # bounded in-flight queue are what un-wedge these runs (round 5)
+    from mpisppy_tpu import dispatch as _dispatch
+    ws = _dispatch.solve_mip(batch_inner.qp, batch_inner.d_col, np.nonzero(
         np.asarray(batch_inner.integer_full))[0].astype(np.int32),
         eval_opts)
     ws_x = np.asarray(ws.x)[:, np.asarray(batch_inner.nonant_idx)]
@@ -201,6 +205,10 @@ def certify(n_scens: int, ascent_steps: int, dd_nodes: int,
         "trivial": float(trivial),
         "first_stage": np.asarray(xhat_best)[
             :len(np.asarray(batch.nonant_idx))].tolist(),
+        # occupancy/recompile evidence for the artifact: how many
+        # megabatches the certification actually dispatched, at what
+        # occupancy, against how many compiled buckets
+        "dispatch": _dispatch.scheduler_stats(),
     }
 
 

@@ -29,12 +29,14 @@ def test_two_process_four_device_dryrun():
            "mpisppy_tpu.parallel._multihost_dryrun", coord, "2"]
     procs = [subprocess.Popen(cmd + [str(pid), "4"], env=env,
                               stdout=subprocess.PIPE,
-                              stderr=subprocess.DEVNULL, text=True)
+                              stderr=subprocess.PIPE, text=True)
              for pid in (0, 1)]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=550)
-        assert p.returncode == 0, out
+        # stderr is CAPTURED and surfaced: a crashing worker previously
+        # reported only "exit 1" with its traceback piped to DEVNULL
+        out, err = p.communicate(timeout=550)
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
         outs.append(out)
     convs = []
     for out in outs:
