@@ -256,6 +256,10 @@ def bench_wheel_to_gap(batch, label, spokes_cfg, ph_opts, wheel_opts=None,
         "label": label,
         "seconds_to_gap": round(elapsed, 3),
         "iterations": iters,
+        # directly gateable steady-state proxy (telemetry/regress.py
+        # GATES keys on sec_per_iter): to-gap wall over iterations —
+        # includes compile+iter0 amortization, so compare like vs like
+        "sec_per_iter": round(elapsed / max(1, iters), 6),
         "rel_gap": float(rel_gap),
         "certified": bool(rel_gap <= GAP_TARGET),
         "outer": float(wheel.BestOuterBound),

@@ -289,7 +289,12 @@ class PH:
         # sequence (ref:mpisppy/phbase.py:851 after _create_solvers)
         self._ext("iter0_post_solver_creation")
         with _prof.annotate("wheel/iter0_solve"):
+            import time as _time
+            _t0 = _time.perf_counter()
             self.state, tb, cert = self._iter0_impl()
+            _dt = _time.perf_counter() - _t0
+        if self.spcomm is not None:
+            self.spcomm.emit_span("iter0_solve", _dt)
         self.trivial_bound = float(tb)
         self.trivial_bound_certified = bool(cert)
         self._ext("post_iter0")
@@ -312,7 +317,14 @@ class PH:
             # (ref callout points: mpisppy/phbase.py:1016-1045)
             self._ext("pre_solve_loop")
             with _prof.annotate("wheel/subproblem_solve"):
+                t_solve = time.perf_counter()
                 self.state = self._iterk_impl()
+                dt_solve = time.perf_counter() - t_solve
+            if self.spcomm is not None:
+                # host wall of the step dispatch; with async XLA the
+                # device wait shows up in the next blocking read (the
+                # hub's harvest span) — docs/telemetry.md
+                self.spcomm.emit_span("subproblem_solve", dt_solve)
             self._ext("post_solve_loop")
             conv = self._read_conv()
             self._ext("enditer")
@@ -332,9 +344,13 @@ class PH:
                 global_toc(f"{self._label} converged at iter {k} "
                            f"(conv={conv:.3e})",
                            self.options.display_progress)
+                if self.spcomm is not None:
+                    self.spcomm._term_reason = "conv-thresh"
                 break
             if (self.options.time_limit is not None
                     and time.time() - t0 > self.options.time_limit):
+                if self.spcomm is not None:
+                    self.spcomm._term_reason = "time-limit"
                 break
         return float(self.state.conv)
 

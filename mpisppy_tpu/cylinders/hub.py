@@ -22,7 +22,9 @@
 ###############################################################################
 from __future__ import annotations
 
+import contextlib
 import math
+import time
 
 import numpy as np
 
@@ -81,6 +83,17 @@ class Hub(SPCommunicator):
             plan.telemetry = self.telemetry
             plan.telemetry_run = self.run_id
         self._last_dispatch_batches = 0
+        # adopt the process-default dispatch scheduler into this run:
+        # its megabatch events then carry this hub's run id and join
+        # the trace exactly (the scheduler is configured by the CLI
+        # before any hub exists, so it cannot know the id itself)
+        try:
+            from mpisppy_tpu import dispatch as _dispatch
+            sched = _dispatch.get_scheduler(create=False)
+            if sched is not None and not sched.run:
+                sched.run = self.run_id
+        except Exception:
+            pass
         self._profiler = None
         if self.options.get("profile_dir"):
             self._profiler = _prof.ProfilerSession(
@@ -101,6 +114,40 @@ class Hub(SPCommunicator):
         """Publish one event for this hub's run (no-op without sinks)."""
         self.telemetry.emit(kind, run=self.run_id, cyl=_cyl,
                             hub_iter=self._iter, **data)
+
+    def emit_span(self, name: str, dur_s: float):
+        """One timed wheel phase (host wall seconds) onto the stream —
+        the analyzer's per-phase breakdown input.  Host-side semantics:
+        a span covers dispatch + any blocking reads inside it, so with
+        async XLA dispatch the device wait lands in whichever span
+        first reads a result (docs/telemetry.md)."""
+        self._emit(tel.SPAN, name=name, dur_s=dur_s)
+
+    @contextlib.contextmanager
+    def _span(self, name: str):
+        """Profiler annotation + SPAN event for one wheel phase."""
+        with _prof.annotate(f"wheel/{name}"):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.emit_span(name, time.perf_counter() - t0)
+
+    def emit_run_end(self, reason: str, **extra):
+        """Emit the run-end record (exit reason + final gap) exactly
+        once — the normal path reaches here via finalize(), a dying
+        wheel via WheelSpinner.spin's unwind (reason "preemption" /
+        "exception"), so a trace always ends with an explicit verdict
+        instead of run termination being inferred from stream
+        truncation (ISSUE 5 satellite)."""
+        if getattr(self, "_run_ended", False):
+            return
+        self._run_ended = True
+        abs_gap, rel_gap = self.compute_gaps()
+        self._emit(tel.RUN_END, reason=reason,
+                   outer=self.BestOuterBound, inner=self.BestInnerBound,
+                   abs_gap=abs_gap, rel_gap=rel_gap,
+                   iterations=self._iter, **extra)
 
     # -- bound bookkeeping (ref:hub.py:207-243) ---------------------------
     # Non-finite values never enter the bookkeeping: a NaN outer bound
@@ -174,10 +221,12 @@ class Hub(SPCommunicator):
         if "rel_gap" in opt and rel_gap <= opt["rel_gap"]:
             global_toc(f"Terminating: rel_gap {rel_gap:.4e} <= "
                        f"{opt['rel_gap']}", True)
+            self._term_reason = "converged"
             return True
         if "abs_gap" in opt and abs_gap <= opt["abs_gap"]:
             global_toc(f"Terminating: abs_gap {abs_gap:.4e} <= "
                        f"{opt['abs_gap']}", True)
+            self._term_reason = "converged"
             return True
         if "max_stalled_iters" in opt:
             # spokes only produce results on exchange iterations, so the
@@ -189,6 +238,7 @@ class Hub(SPCommunicator):
                     >= opt["max_stalled_iters"] * period
                     and self.BestInnerBound < math.inf):
                 global_toc("Terminating: inner bound stalled", True)
+                self._term_reason = "stalled"
                 return True
         return False
 
@@ -423,8 +473,15 @@ class PHHub(Hub):
             self._sync_body()
 
     def _sync_body(self):
+        # stamp the current hub iteration onto the out-of-band emitters
+        # (dispatch megabatches, fault seams) so their events join the
+        # iteration timeline exactly, not by seq-window heuristics
+        # (ISSUE 5 satellite); -1 remains the pre-wheel stamp
+        from mpisppy_tpu import dispatch as _dispatch
+        _dispatch.set_hub_iter(self._iter)
         plan = self.options.get("fault_plan")
         if plan is not None:
+            plan.telemetry_iter = self._iter
             # chaos seams (resilience/faults): a simulated preemption
             # unwinds to WheelSpinner.spin's emergency save; lane
             # corruption mutates the solver state host-side so the
@@ -439,7 +496,7 @@ class PHHub(Hub):
         fused = [sp for sp in self.spokes if getattr(sp, "fused", False)]
         classic = [sp for sp in self.spokes if not getattr(sp, "fused",
                                                            False)]
-        with _prof.annotate("wheel/harvest"):
+        with self._span("harvest"):
             self._harvest_all(only=fused)
             if do_spokes:
                 self._harvest_all(only=classic)
@@ -454,15 +511,15 @@ class PHHub(Hub):
         # building the snapshot dispatches a (small) device gather; with
         # an all-fused wheel no consumer exists, so skip it off-sync
         if (do_spokes and classic) or self.options.get("publish_snapshots"):
-            with _prof.annotate("wheel/hub_sync"):
+            with self._span("hub_sync"):
                 payload = self._snapshot()
                 self.from_hub.put(payload)  # for API parity / inspection
             if do_spokes:
-                with _prof.annotate("wheel/spoke_update"):
+                with self._span("spoke_update"):
                     for sp in classic:
                         if not getattr(sp, "disabled", False):
                             sp.update(payload)
-        with _prof.annotate("wheel/checkpoint"):
+        with self._span("checkpoint"):
             self._maybe_checkpoint()
         self._harvest_kernel_counters()
         self._harvest_dispatch_stats()
@@ -868,9 +925,8 @@ class PHHub(Hub):
         if self._profiler is not None:
             self._profiler.close()
         self._harvest_kernel_counters()  # final totals after last iterk
-        abs_gap, rel_gap = self.compute_gaps()
-        self._emit(tel.RUN_END, outer=self.BestOuterBound,
-                   inner=self.BestInnerBound, rel_gap=rel_gap)
+        self.emit_run_end(getattr(self, "_term_reason", None)
+                          or "max-iter")
         return self.BestInnerBound
 
     def hub_finalize(self):

@@ -34,8 +34,10 @@ from mpisppy_tpu.dispatch.scheduler import (  # noqa: F401
     DispatchOptions,
     SolveScheduler,
     configure,
+    current_hub_iter,
     from_cfg,
     get_scheduler,
     scheduler_stats,
+    set_hub_iter,
     solve_mip,
 )

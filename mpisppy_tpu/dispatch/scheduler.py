@@ -50,6 +50,24 @@ from mpisppy_tpu.dispatch import compilewatch as _cw
 from mpisppy_tpu.telemetry import metrics as _metrics
 
 
+# -- hub-iteration stamp (ISSUE 5 satellite) --------------------------------
+# The hub calls set_hub_iter at the top of every sync; every dispatch
+# event carries the current value so the analyzer joins megabatches to
+# the iteration timeline exactly.  -1 = pre-wheel (warm-up compiles,
+# iter0 oracle work).  A plain int write/read — no lock needed for a
+# monotone diagnostic stamp.
+_hub_iter = -1
+
+
+def set_hub_iter(it: int) -> None:
+    global _hub_iter
+    _hub_iter = int(it)
+
+
+def current_hub_iter() -> int:
+    return _hub_iter
+
+
 @dataclasses.dataclass(frozen=True)
 class DispatchOptions:
     """Scheduler knobs (CLI: the --dispatch-* group, utils/config.py)."""
@@ -456,6 +474,7 @@ class SolveScheduler:
             from mpisppy_tpu import telemetry as tel
             self.bus.emit(
                 tel.DISPATCH, run=self.run, cyl="dispatch",
+                hub_iter=_hub_iter,
                 requests=len(sizes), lanes=real, padded_to=S_pad,
                 occupancy=occ, bucket=list(sig[:3]),
                 wait_ms=1e3 * (t_launch - win.t0),
@@ -488,6 +507,10 @@ def configure(options: DispatchOptions | None = None, bus=None,
         old, _default = _default, None
     if old is not None:
         old.close()
+    # a fresh scheduler means a fresh run: drop the previous wheel's
+    # final hub-iteration stamp or the new run's warm-up dispatches
+    # would join a bogus old iteration instead of reading pre-wheel
+    set_hub_iter(-1)
     sched = SolveScheduler(options or DispatchOptions(), bus=bus, run=run)
     with _default_lock:
         _default = sched

@@ -351,21 +351,41 @@ def _do_decomp(cfg, module):
     # --metrics-snapshot build the run's event bus; the hub emits into
     # it and the finally below flushes the sinks even on preemption
     tel_bus = telemetry.from_cfg(cfg)
+    # crash flight recorder (docs/telemetry.md): an always-on bounded
+    # ring of the last ~512 events, even with --trace-jsonl OFF —
+    # WheelSpinner.spin dumps it to flight-<runid>.jsonl when the wheel
+    # dies, so every crash leaves a black box.  When no trace/metrics
+    # bus exists, a private bus carries just the recorder (and the
+    # console stream, so the black box holds the final log lines too —
+    # stdout rendering is unchanged: the private bus has no ConsoleSink)
+    wheel_bus, own_bus = tel_bus, False
+    if cfg.get("flight_recorder", True):
+        from mpisppy_tpu.telemetry import flightrec
+        if wheel_bus is None:
+            wheel_bus = telemetry.EventBus()
+            telemetry.console.attach(wheel_bus)
+            own_bus = True
+        wheel_bus.subscribe(flightrec.FlightRecorder(
+            capacity=int(cfg.get("flight_capacity", 512)),
+            dump_dir=cfg.get("flight_dir", ".")))
     # dispatch scheduler (docs/dispatch.md): the --dispatch-* group
     # configures the process-default scheduler every MIP-oracle solve
     # routes through; with a bus attached each megabatch dispatch also
-    # lands in the JSONL trace
+    # lands in the JSONL trace (and the flight recorder's ring)
     from mpisppy_tpu import dispatch as _dispatch
-    _dispatch.from_cfg(cfg, bus=tel_bus)
-    if tel_bus is not None:
+    _dispatch.from_cfg(cfg, bus=wheel_bus)
+    if wheel_bus is not None:
         hub = dict(hub)
         hub["hub_kwargs"] = dict(hub.get("hub_kwargs", {}))
         hub_opts = dict(hub["hub_kwargs"].get("options", {}))
-        hub_opts["telemetry_bus"] = tel_bus
+        hub_opts["telemetry_bus"] = wheel_bus
         hub["hub_kwargs"]["options"] = hub_opts
     try:
         return _spin_and_report(cfg, module, hub, spokes, names, specs)
     finally:
+        if own_bus:
+            telemetry.console.detach(wheel_bus)
+            wheel_bus.close()
         telemetry.close_bus(tel_bus)
 
 

@@ -136,9 +136,14 @@ class FaultPlan:
         # set by the hub when the plan is armed in its options: every
         # injection also lands in the telemetry stream as a
         # fault-injected event (docs/telemetry.md), so a chaos run's
-        # trace shows WHAT was injected next to what the guards did
+        # trace shows WHAT was injected next to what the guards did.
+        # telemetry_iter is the hub-iteration stamp (-1 pre-wheel),
+        # refreshed by the hub each sync AND by every seam that
+        # receives the iteration directly, so the analyzer joins
+        # injections to the timeline exactly (ISSUE 5 satellite).
         self.telemetry = None
         self.telemetry_run = ""
+        self.telemetry_iter = -1
 
     def _fire(self, seam: str, detail: str) -> None:
         self.fired.append((seam, detail))
@@ -146,7 +151,8 @@ class FaultPlan:
             from mpisppy_tpu.telemetry import FAULT_INJECTED
             self.telemetry.emit(FAULT_INJECTED, run=self.telemetry_run,
                                 cyl="fault-plan", seam=seam,
-                                detail=detail)
+                                detail=detail,
+                                hub_iter=self.telemetry_iter)
 
     @property
     def armed(self) -> bool:
@@ -157,6 +163,7 @@ class FaultPlan:
     def filter_bound(self, spoke_index: int, sense: str, bound: float,
                      hub_iter: int) -> float:
         """Return the (possibly poisoned) bound the hub should see."""
+        self.telemetry_iter = hub_iter
         if spoke_index not in self._first_seen and np.isfinite(bound):
             self._first_seen[spoke_index] = bound
         for f in self.spoke_bounds:
@@ -180,6 +187,7 @@ class FaultPlan:
     def corrupt_lanes(self, hub_iter: int, opt) -> bool:
         """Scale/NaN the configured lanes of opt.state.solver.  Returns
         True when something was corrupted."""
+        self.telemetry_iter = hub_iter
         todo = [f for f in self.lanes if f.at_iter == hub_iter]
         if not todo or getattr(opt, "state", None) is None:
             return False
@@ -230,6 +238,7 @@ class FaultPlan:
 
     # -- seam: preemption (hub.sync) --------------------------------------
     def maybe_preempt(self, hub_iter: int) -> None:
+        self.telemetry_iter = hub_iter
         if (self.preempt_at_iter is not None and not self._preempted
                 and hub_iter >= self.preempt_at_iter):
             self._preempted = True

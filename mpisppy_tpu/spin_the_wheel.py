@@ -76,7 +76,7 @@ class WheelSpinner:
             if ckpt_path else None
         try:
             self.spcomm.main()
-        except PreemptionError:
+        except PreemptionError as e:
             self.preempted = True
             if ckpt_path:
                 saved = self.spcomm.emergency_checkpoint(ckpt_path)
@@ -84,6 +84,13 @@ class WheelSpinner:
                     f"preempted: emergency checkpoint "
                     f"{'written to ' + ckpt_path if saved else 'SKIPPED'}"
                     f" at hub iter {self.spcomm._iter}", True)
+            # run-end with an explicit exit reason + black-box dump,
+            # AFTER the emergency save (the save must win the race for
+            # the eviction grace window; docs/telemetry.md)
+            self._record_crash("preemption", e)
+            raise
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            self._record_crash("exception", e)
             raise
         finally:
             self._restore_preemption_handlers(prev_handlers)
@@ -92,6 +99,27 @@ class WheelSpinner:
         self.spcomm.hub_finalize()
         self.spcomm.free_windows()
         return self
+
+    def _record_crash(self, reason: str, exc: BaseException) -> None:
+        """Last words of a dying wheel: emit the run-end event (exit
+        reason + final gap) and dump any flight-recorder black box
+        subscribed to the hub's bus to flight-<runid>.jsonl.  Best
+        effort by construction — the original exception keeps
+        propagating whatever happens here."""
+        detail = f"{type(exc).__name__}: {exc}"
+        try:
+            self.spcomm.emit_run_end(reason, error=detail)
+        except Exception:
+            pass
+        try:
+            from mpisppy_tpu.telemetry import flightrec
+            bus = getattr(self.spcomm, "telemetry", None)
+            for path in flightrec.dump_all(bus, reason=detail):
+                if path:
+                    global_toc(f"flight recorder: black box written "
+                               f"to {path}", True)
+        except Exception:
+            pass
 
     # -- preemption signal plumbing ---------------------------------------
     @staticmethod

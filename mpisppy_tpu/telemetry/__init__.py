@@ -13,6 +13,12 @@
 #   counters  — on-device PDHG kernel counters (imports jax; import the
 #               submodule directly)
 #   profiler  — jax.profiler spans + the --profile-dir session (ditto)
+#   flightrec — the always-on crash black box (last ~512 events,
+#               dumped to flight-<runid>.jsonl when the wheel dies)
+#   analyze   — trace -> typed run model -> phase/bound/stall/dispatch
+#               report (`python -m mpisppy_tpu.telemetry analyze`)
+#   regress   — perf compare/gate over analyzer reports and
+#               BENCH_*.json artifacts (`... compare|gate`)
 #
 # This package (minus counters/profiler) imports only the stdlib, so a
 # host-only consumer can read traces without a jax install.
@@ -24,9 +30,10 @@ from mpisppy_tpu.telemetry.bus import EventBus
 from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT, CHECKPOINT_RESTORE,
     CHECKPOINT_WRITE, CONSOLE, DISPATCH, FAULT_INJECTED, HUB_ITERATION,
-    KERNEL_COUNTERS, LANE_QUARANTINE, PROFILE, RUN_END, RUN_START,
+    KERNEL_COUNTERS, LANE_QUARANTINE, PROFILE, RUN_END, RUN_START, SPAN,
     SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, Event, new_run_id,
 )
+from mpisppy_tpu.telemetry.flightrec import FlightRecorder  # noqa: F401
 from mpisppy_tpu.telemetry.sinks import (  # noqa: F401
     ConsoleSink, JsonlSink, MetricsSnapshotSink, Sink,
 )

@@ -1,0 +1,91 @@
+###############################################################################
+# `python -m mpisppy_tpu.telemetry <analyze|compare|gate>` — the trace
+# toolbox CLI (ISSUE 5; docs/telemetry.md).  Pure host-side stdlib: runs
+# on any machine holding a trace, no jax required.
+#
+#   analyze --trace-jsonl T [--run ID] [--json]
+#       per-phase wall-time breakdown, bound progress + stalls,
+#       per-spoke bound attribution, dispatch audit, crash forensics —
+#       T may be a --trace-jsonl stream OR a flight-<runid>.jsonl dump.
+#   compare OLD NEW [--json]
+#       diff the perf metrics of two artifacts (analyzer --json
+#       reports, BENCH_DETAIL.json, or BENCH_r0N.json wrappers).
+#   gate OLD NEW [--threshold KEY=FRAC ...] [--json]
+#       compare + direction-aware thresholds; exit 2 on a regression.
+###############################################################################
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mpisppy_tpu.telemetry",
+        description="wheel trace analyzer / perf-regression gate")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pa = sub.add_parser("analyze", help="analyze a JSONL wheel trace")
+    pa.add_argument("--trace-jsonl", required=True,
+                    help="trace file (--trace-jsonl output or a "
+                         "flight-<runid>.jsonl black box)")
+    pa.add_argument("--run", default=None,
+                    help="run id to analyze (default: last in stream)")
+    pa.add_argument("--json", action="store_true",
+                    help="machine report instead of the human rendering")
+
+    for name, hlp in (("compare", "diff two perf artifacts"),
+                      ("gate", "compare + thresholds; exit 2 on "
+                               "regression")):
+        pc = sub.add_parser(name, help=hlp)
+        pc.add_argument("old")
+        pc.add_argument("new")
+        pc.add_argument("--json", action="store_true")
+        if name == "gate":
+            pc.add_argument("--threshold", action="append", default=[],
+                            metavar="KEY=FRAC",
+                            help="override: metric-key substring = "
+                                 "relative threshold (repeatable)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "analyze":
+        from mpisppy_tpu.telemetry import analyze as an
+        try:
+            rep = an.analyze_path(args.trace_jsonl, run=args.run)
+        except (OSError, ValueError) as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(rep) if args.json else an.render_report(rep))
+        return 0
+
+    from mpisppy_tpu.telemetry import regress
+    overrides = {}
+    for spec in getattr(args, "threshold", []):
+        try:
+            key, frac = spec.split("=", 1)
+            overrides[key] = float(frac)
+        except ValueError:
+            print(f"bad --threshold {spec!r} (want KEY=FRAC)",
+                  file=sys.stderr)
+            return 1
+    try:
+        if args.cmd == "gate":
+            rep = regress.gate_paths(args.old, args.new, overrides)
+        else:
+            rep = regress.compare_paths(args.old, args.new)
+    except (OSError, ValueError) as e:
+        print(f"{args.cmd}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(rep) if args.json
+          else regress.render_compare(rep, only_gated=False))
+    if args.cmd == "gate" and not rep["ok"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

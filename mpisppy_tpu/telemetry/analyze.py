@@ -1,0 +1,537 @@
+###############################################################################
+# Trace analyzer (ISSUE 5 tentpole, part 1; docs/telemetry.md).
+#
+# Consumes the JSONL event stream the wheel emits (--trace-jsonl, or a
+# flight-recorder dump) and answers the first questions of every run of
+# a hub-and-spoke wheel (Knueven et al., MPC 2023): where did the wall
+# time go, which spoke produced the binding bounds, is the gap moving
+# or stalled, and is the dispatch tunnel healthy?
+#
+#   rows  = load_trace("trace.jsonl")
+#   model = build_run_model(rows)           # typed run -> iters -> events
+#   rep   = analyze(model)                  # the machine report (JSON)
+#   text  = render_report(rep)              # the human report
+#
+# Pure stdlib on purpose: a host without jax (a laptop holding a trace
+# scp'd off a TPU pool) can run `python -m mpisppy_tpu.telemetry
+# analyze` on any trace or black box.  Joins are exact: events carry
+# run ids, hub_iter stamps (ISSUE 5 satellite — dispatch / fault /
+# quarantine events are stamped at emit time, -1 pre-wheel), and the
+# per-bus seq total order; no seq-window heuristics.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+
+from mpisppy_tpu.telemetry import events as ev
+from mpisppy_tpu.telemetry import flightrec
+
+ANALYZE_SCHEMA = "mpisppy-tpu-analyze/1"
+
+#: rel-gap thresholds the time-to-gap table reports (the 1% target is
+#: the BENCH_METHODOLOGY headline)
+GAP_TARGETS = (0.05, 0.02, 0.01)
+
+
+def load_trace(path: str) -> list[dict]:
+    """Parse a JSONL trace (or flight dump) into row dicts.  A torn
+    final line — the signature of a crashed writer — is skipped, not
+    fatal: a crash trace must stay analyzable by construction."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def runs_in(rows: list[dict]) -> list[str]:
+    """Distinct run ids in stream order (a restarted run appends a new
+    segment to the same file; ids delimit the segments)."""
+    seen: list[str] = []
+    for r in rows:
+        run = r.get("run")
+        if run and run not in seen:
+            seen.append(run)
+    return seen
+
+
+@dataclasses.dataclass
+class HubIter:
+    """One hub iteration joined from its events."""
+
+    it: int
+    t_wall: float | None = None
+    t_mono: float | None = None
+    data: dict = dataclasses.field(default_factory=dict)
+    harvests: list = dataclasses.field(default_factory=list)
+    accepts: list = dataclasses.field(default_factory=list)
+    rejects: list = dataclasses.field(default_factory=list)
+    spans: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RunModel:
+    """Typed model of one run: run -> hub iterations -> joined events,
+    plus the cross-iteration streams (dispatch, faults, checkpoints)."""
+
+    run: str
+    rows: list = dataclasses.field(default_factory=list)
+    header: dict | None = None        # flight-recorder dump header
+    start: dict | None = None         # run-start row
+    end: dict | None = None           # run-end row
+    iters: dict = dataclasses.field(default_factory=dict)  # it -> HubIter
+    spans: list = dataclasses.field(default_factory=list)
+    strikes: list = dataclasses.field(default_factory=list)
+    disables: list = dataclasses.field(default_factory=list)
+    evicts: list = dataclasses.field(default_factory=list)
+    quarantines: list = dataclasses.field(default_factory=list)
+    faults: list = dataclasses.field(default_factory=list)
+    ckpt_writes: list = dataclasses.field(default_factory=list)
+    ckpt_restores: list = dataclasses.field(default_factory=list)
+    megabatches: list = dataclasses.field(default_factory=list)
+    dispatch_stats: list = dataclasses.field(default_factory=list)
+    kernel: dict = dataclasses.field(default_factory=dict)  # cyl -> last
+    spoke_classes: dict = dataclasses.field(default_factory=dict)
+
+    def iter_of(self, it: int) -> HubIter:
+        if it not in self.iters:
+            self.iters[it] = HubIter(it)
+        return self.iters[it]
+
+    @property
+    def t0_mono(self) -> float | None:
+        monos = [r["t_mono"] for r in self.rows if "t_mono" in r]
+        return min(monos) if monos else None
+
+    @property
+    def t1_mono(self) -> float | None:
+        monos = [r["t_mono"] for r in self.rows if "t_mono" in r]
+        return max(monos) if monos else None
+
+
+def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
+    """Join one run's events into a RunModel.  `run=None` picks the
+    LAST run id in the stream — with segment-appending traces (a
+    preempted run restarted onto the same --trace-jsonl path) the
+    newest segment is the one being diagnosed."""
+    runs = runs_in(rows)
+    if run is None:
+        if not runs:
+            raise ValueError("no run ids in the trace "
+                             "(empty or console-only stream)")
+        run = runs[-1]
+    elif run not in runs:
+        raise ValueError(f"run {run!r} not in trace (have: {runs})")
+    m = RunModel(run=run)
+    for r in rows:
+        if r.get("kind") == flightrec.HEADER_KIND:
+            if r.get("run") in (run, "unknown"):
+                m.header = r
+            continue
+        if r.get("run") != run:
+            # a scheduler configured before the hub minted its run id
+            # emits dispatch rows with run="" — keep them (single-wheel
+            # processes; the hub adopts the scheduler afterwards)
+            if not (r.get("kind") == ev.DISPATCH and not r.get("run")):
+                continue
+        m.rows.append(r)
+        kind, data, it = r.get("kind"), r.get("data", {}), r.get("iter")
+        if kind == ev.RUN_START:
+            m.start = r
+        elif kind == ev.RUN_END:
+            m.end = r
+        elif kind == ev.HUB_ITERATION:
+            hi = m.iter_of(data.get("iter", it))
+            hi.t_wall, hi.t_mono = r.get("t_wall"), r.get("t_mono")
+            hi.data = data
+        elif kind == ev.SPOKE_HARVEST:
+            m.iter_of(it).harvests.append(data)
+            if "spoke" in data and "spoke_class" in data:
+                m.spoke_classes[data["spoke"]] = data["spoke_class"]
+        elif kind == ev.BOUND_ACCEPT:
+            m.iter_of(it).accepts.append(data)
+        elif kind == ev.BOUND_REJECT:
+            m.iter_of(it).rejects.append(data)
+        elif kind == ev.SPAN:
+            m.spans.append({"iter": it, **data})
+            if it is not None:
+                spans = m.iter_of(it).spans
+                name = data.get("name", "?")
+                spans[name] = spans.get(name, 0.0) + data.get("dur_s", 0.0)
+        elif kind == ev.SPOKE_STRIKE:
+            m.strikes.append({"iter": it, **data})
+        elif kind == ev.SPOKE_DISABLE:
+            m.disables.append({"iter": it, **data})
+        elif kind == ev.BOUND_EVICT:
+            m.evicts.append({"iter": it, **data})
+        elif kind == ev.LANE_QUARANTINE:
+            m.quarantines.append({"iter": it, **data})
+        elif kind == ev.FAULT_INJECTED:
+            m.faults.append({"iter": it, **data})
+        elif kind == ev.CHECKPOINT_WRITE:
+            m.ckpt_writes.append({"iter": it, **data})
+        elif kind == ev.CHECKPOINT_RESTORE:
+            m.ckpt_restores.append({"iter": it, **data})
+        elif kind == ev.DISPATCH:
+            # two producers share the kind (docs/telemetry.md): the
+            # scheduler's per-megabatch row (cyl "dispatch") and the
+            # hub's cumulative per-sync stats row (cyl "hub")
+            if r.get("cyl") == "dispatch":
+                m.megabatches.append({"iter": it, **data})
+            else:
+                m.dispatch_stats.append({"iter": it, **data})
+        elif kind == ev.KERNEL_COUNTERS:
+            m.kernel["hub" if r.get("cyl") in (None, "", "hub")
+                     else r["cyl"]] = data
+    return m
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2] if xs else None
+
+
+def _finite(v):
+    return v if isinstance(v, (int, float)) and math.isfinite(v) else None
+
+
+def _phase_breakdown(model: RunModel) -> dict:
+    agg: dict[str, dict] = {}
+    for s in model.spans:
+        a = agg.setdefault(s.get("name", "?"),
+                           {"calls": 0, "total_s": 0.0, "max_s": 0.0})
+        d = float(s.get("dur_s") or 0.0)
+        a["calls"] += 1
+        a["total_s"] += d
+        a["max_s"] = max(a["max_s"], d)
+    grand = sum(a["total_s"] for a in agg.values()) or 1.0
+    for a in agg.values():
+        a["mean_s"] = a["total_s"] / max(1, a["calls"])
+        a["share"] = a["total_s"] / grand
+        for k in ("total_s", "mean_s", "max_s", "share"):
+            a[k] = round(a[k], 6)
+    return dict(sorted(agg.items(), key=lambda kv: -kv[1]["total_s"]))
+
+
+def _iteration_stats(model: RunModel) -> dict:
+    hs = sorted((h for h in model.iters.values() if h.t_mono is not None),
+                key=lambda h: h.it)
+    deltas = [b.t_mono - a.t_mono for a, b in zip(hs, hs[1:])
+              if b.it == a.it + 1]
+    steady = deltas[2:] if len(deltas) > 4 else deltas
+    out = {"count": len(hs),
+           "wall_s": (round(hs[-1].t_mono - hs[0].t_mono, 6)
+                      if len(hs) > 1 else 0.0),
+           "sec_per_iter_median": None, "sec_per_iter_p90": None}
+    if steady:
+        out["sec_per_iter_median"] = round(_median(steady), 6)
+        out["sec_per_iter_p90"] = round(
+            sorted(steady)[min(len(steady) - 1,
+                               int(0.9 * len(steady)))], 6)
+    return out
+
+
+def _bound_progress(model: RunModel) -> dict:
+    hs = sorted(model.iters.values(), key=lambda h: h.it)
+    traj = [(h.it, _finite(h.data.get("outer")),
+             _finite(h.data.get("inner")), _finite(h.data.get("rel_gap")))
+            for h in hs if h.data]
+    last_move = {"outer": None, "inner": None}
+    prev = {"outer": None, "inner": None}
+    for it, ob, ib, _ in traj:
+        for side, v in (("outer", ob), ("inner", ib)):
+            if v is not None and v != prev[side]:
+                last_move[side] = it
+                prev[side] = v
+    last_iter = traj[-1][0] if traj else 0
+    gaps = [(it, g) for it, _, _, g in traj if g is not None]
+    t0 = model.t0_mono
+    time_to_gap = {}
+    for target in GAP_TARGETS:
+        hit = next((h for h in hs
+                    if _finite(h.data.get("rel_gap")) is not None
+                    and h.data["rel_gap"] <= target), None)
+        time_to_gap[f"{target:g}"] = None if hit is None else {
+            "iter": hit.it,
+            "seconds": (round(hit.t_mono - t0, 6)
+                        if hit.t_mono is not None and t0 is not None
+                        else None)}
+    return {
+        "final_outer": prev["outer"],
+        "final_inner": prev["inner"],
+        "final_rel_gap": gaps[-1][1] if gaps else None,
+        "min_rel_gap": min((g for _, g in gaps), default=None),
+        "first_rel_gap": gaps[0][1] if gaps else None,
+        "iters_since_outer_moved": (None if last_move["outer"] is None
+                                    else last_iter - last_move["outer"]),
+        "iters_since_inner_moved": (None if last_move["inner"] is None
+                                    else last_iter - last_move["inner"]),
+        "time_to_gap": time_to_gap,
+        "gap_trajectory_tail": [[it, g] for it, g in gaps[-8:]],
+    }
+
+
+def _spoke_attribution(model: RunModel) -> dict:
+    spokes: dict = {}
+
+    def rec(j):
+        return spokes.setdefault(j, {
+            "class": model.spoke_classes.get(j),
+            "harvests": 0, "accepts": 0, "binding_accepts": 0,
+            "rejects": 0, "strikes": 0, "disabled": False,
+            "senses": [], "last_bound": None})
+
+    for hi in model.iters.values():
+        for h in hi.harvests:
+            r = rec(h.get("spoke"))
+            r["harvests"] += 1
+            if h.get("sense") not in r["senses"]:
+                r["senses"].append(h.get("sense"))
+        for a in hi.accepts:
+            r = rec(a.get("spoke"))
+            r["accepts"] += 1
+            r["last_bound"] = a.get("bound")
+            if a.get("improved"):
+                r["binding_accepts"] += 1
+        for x in hi.rejects:
+            rec(x.get("spoke"))["rejects"] += 1
+    for s in model.strikes:
+        rec(s.get("spoke"))["strikes"] = max(
+            rec(s.get("spoke"))["strikes"], s.get("strikes", 0))
+    for d in model.disables:
+        rec(d.get("spoke"))["disabled"] = True
+    # who holds the final incumbent of each side: the LAST improving
+    # accept per sense in the stream
+    binding = {}
+    for hi in sorted(model.iters.values(), key=lambda h: h.it):
+        for a in hi.accepts:
+            if a.get("improved"):
+                binding[a.get("sense")] = {
+                    "spoke": a.get("spoke"),
+                    "class": model.spoke_classes.get(a.get("spoke")),
+                    "bound": a.get("bound"), "iter": hi.it}
+    return {"spokes": {str(k): v for k, v in sorted(spokes.items())},
+            "final_bound_producer": binding}
+
+
+def _dispatch_audit(model: RunModel) -> dict | None:
+    if not model.megabatches and not model.dispatch_stats:
+        return None
+    out: dict = {}
+    mbs = model.megabatches
+    if mbs:
+        lanes = sum(b.get("lanes", 0) for b in mbs)
+        padded = sum(b.get("padded_to", 0) for b in mbs)
+        out.update({
+            "megabatches": len(mbs),
+            "lanes": lanes,
+            "occupancy_mean": round(lanes / padded, 4) if padded else None,
+            "wait_ms_med": round(_median(
+                [b.get("wait_ms", 0.0) for b in mbs]), 3),
+            "wait_ms_max": round(max(b.get("wait_ms", 0.0)
+                                     for b in mbs), 3),
+            "queue_depth_max": max(b.get("queue_depth", 0) for b in mbs),
+            "coalesced": sum(1 for b in mbs if b.get("requests", 1) > 1),
+            "pre_wheel": sum(1 for b in mbs if (b.get("iter") or 0) < 0),
+        })
+    if model.dispatch_stats:
+        last = model.dispatch_stats[-1]
+        out.update({
+            "batches_total": last.get("batches"),
+            "buckets": last.get("buckets"),
+            "backend_compiles": last.get("backend_compiles"),
+            "unexpected_recompiles": last.get("unexpected_recompiles"),
+            "inflight_max": last.get("inflight_max"),
+        })
+        # compile-cache discipline: in steady state each shape bucket
+        # compiles once; more compiles than buckets means the ladder is
+        # leaking (docs/dispatch.md)
+        b, c = last.get("buckets"), last.get("backend_compiles")
+        if b and c is not None:
+            out["compiles_per_bucket"] = round(c / b, 3)
+    return out
+
+
+def _resilience_summary(model: RunModel) -> dict:
+    by_seam: dict[str, int] = {}
+    for f in model.faults:
+        by_seam[f.get("seam", "?")] = by_seam.get(f.get("seam", "?"), 0) + 1
+    return {
+        "faults_injected": by_seam,
+        "spoke_strikes": len(model.strikes),
+        "spokes_disabled": len({d.get("spoke") for d in model.disables}),
+        "bound_evictions": len(model.evicts),
+        "lane_quarantine_resets": sum(q.get("resets", 0)
+                                      for q in model.quarantines),
+        "checkpoint_writes": len(model.ckpt_writes),
+        "checkpoint_restores": len(model.ckpt_restores),
+        "restore_fallbacks": sum(1 for c in model.ckpt_restores
+                                 if c.get("fallback")),
+    }
+
+
+def _exit_info(model: RunModel) -> dict:
+    if model.end is not None:
+        d = dict(model.end.get("data", {}))
+        d.setdefault("reason", "unknown")
+        return d
+    if model.header is not None:
+        return {"reason": "truncated",
+                "flight_reason": model.header.get("reason")}
+    return {"reason": "truncated"}
+
+
+def analyze(model: RunModel) -> dict:
+    """The machine report: one JSON-able dict per run."""
+    it_stats = _iteration_stats(model)
+    bounds = _bound_progress(model)
+    exit_info = _exit_info(model)
+    # run-end carries the truly-final bounds (finalize's last harvest
+    # can improve on the last hub-iteration row); prefer them
+    for k in ("outer", "inner", "rel_gap"):
+        v = _finite(exit_info.get(k))
+        if v is not None:
+            bounds[f"final_{k}"] = v
+    rep = {
+        "schema": ANALYZE_SCHEMA,
+        "run": {
+            "id": model.run,
+            "hub_class": (model.start or {}).get("data", {})
+            .get("hub_class"),
+            "num_spokes": (model.start or {}).get("data", {})
+            .get("num_spokes"),
+            "events": len(model.rows),
+            "exit": exit_info,
+        },
+        "iteration": it_stats,
+        "phases": _phase_breakdown(model),
+        "bounds": bounds,
+        "attribution": _spoke_attribution(model),
+        "dispatch": _dispatch_audit(model),
+        "resilience": _resilience_summary(model),
+        "kernel": model.kernel,
+    }
+    flags = []
+    stall = bounds.get("iters_since_outer_moved")
+    n = max(1, it_stats["count"])
+    if stall is not None and stall >= max(5, n // 2):
+        flags.append(f"outer bound stalled for {stall} iterations")
+    stall_i = bounds.get("iters_since_inner_moved")
+    if stall_i is not None and stall_i >= max(5, n // 2):
+        flags.append(f"inner bound stalled for {stall_i} iterations")
+    if exit_info.get("reason") == "truncated":
+        flags.append("stream truncated: no run-end event "
+                     "(crash, kill, or tracing stopped mid-run)")
+    disp = rep["dispatch"]
+    if disp and (disp.get("unexpected_recompiles") or 0) > 0:
+        flags.append(f"{disp['unexpected_recompiles']} unexpected "
+                     "warm-bucket recompile(s)")
+    if rep["resilience"]["spokes_disabled"]:
+        flags.append(f"{rep['resilience']['spokes_disabled']} spoke(s) "
+                     "auto-disabled")
+    if rep["resilience"]["bound_evictions"]:
+        flags.append(f"{rep['resilience']['bound_evictions']} incumbent "
+                     "bound eviction(s)")
+    rep["flags"] = flags
+    return rep
+
+
+def analyze_path(path: str, run: str | None = None) -> dict:
+    return analyze(build_run_model(load_trace(path), run=run))
+
+
+# ---------------------------------------------------------------------------
+# the human rendering
+# ---------------------------------------------------------------------------
+def _fmt(v, spec=".6g"):
+    return "-" if v is None else format(v, spec)
+
+
+def render_report(rep: dict) -> str:
+    L: list[str] = []
+    r, ex = rep["run"], rep["run"]["exit"]
+    L.append(f"run {r['id']}  hub={r.get('hub_class') or '?'}  "
+             f"spokes={r.get('num_spokes', '?')}  events={r['events']}")
+    L.append(f"exit: {ex.get('reason')}"
+             + (f"  rel_gap={_fmt(ex.get('rel_gap'), '.3e')}"
+                if ex.get("rel_gap") is not None else "")
+             + (f"  ({ex.get('flight_reason')})"
+                if ex.get("flight_reason") else ""))
+    it = rep["iteration"]
+    L.append(f"iterations: {it['count']}  wall {_fmt(it['wall_s'], '.3f')}s"
+             f"  sec/iter median {_fmt(it['sec_per_iter_median'], '.4g')}"
+             f"  p90 {_fmt(it['sec_per_iter_p90'], '.4g')}")
+    if rep["phases"]:
+        L.append("phases (host wall):")
+        for name, a in rep["phases"].items():
+            L.append(f"  {name:<18} {a['total_s']:9.3f}s"
+                     f"  {100 * a['share']:5.1f}%"
+                     f"  x{a['calls']}  mean {a['mean_s']:.4g}s")
+    b = rep["bounds"]
+    L.append(f"bounds: outer {_fmt(b['final_outer'])}  "
+             f"inner {_fmt(b['final_inner'])}  "
+             f"rel_gap {_fmt(b['final_rel_gap'], '.3e')} "
+             f"(min {_fmt(b['min_rel_gap'], '.3e')})")
+    L.append(f"  stall: outer moved {_fmt(b['iters_since_outer_moved'])} "
+             f"iters ago, inner {_fmt(b['iters_since_inner_moved'])}")
+    for tgt, hit in b["time_to_gap"].items():
+        if hit is not None:
+            L.append(f"  gap<={tgt}: iter {hit['iter']}"
+                     f" @ {_fmt(hit['seconds'], '.3f')}s")
+    at = rep["attribution"]
+    for sense, w in at["final_bound_producer"].items():
+        L.append(f"  binding {sense}: spoke {w['spoke']}"
+                 f" ({w.get('class') or '?'}) = {_fmt(w['bound'])}"
+                 f" at iter {w['iter']}")
+    if at["spokes"]:
+        L.append("spokes:")
+        for j, s in at["spokes"].items():
+            L.append(f"  [{j}] {s.get('class') or '?':<28}"
+                     f" harvests {s['harvests']:4d}  accepts"
+                     f" {s['accepts']:4d} ({s['binding_accepts']} binding)"
+                     f"  rejects {s['rejects']}  strikes {s['strikes']}"
+                     + ("  DISABLED" if s["disabled"] else ""))
+    d = rep["dispatch"]
+    if d:
+        L.append("dispatch:"
+                 + (f" megabatches {d.get('megabatches')}"
+                    f"  lanes {d.get('lanes')}"
+                    f"  occupancy {_fmt(d.get('occupancy_mean'))}"
+                    f"  wait_ms med {_fmt(d.get('wait_ms_med'))}"
+                    f"/max {_fmt(d.get('wait_ms_max'))}"
+                    if d.get("megabatches") else "")
+                 + (f"  buckets {d.get('buckets')}"
+                    f"  compiles {d.get('backend_compiles')}"
+                    f" ({_fmt(d.get('compiles_per_bucket'))}/bucket)"
+                    f"  unexpected {d.get('unexpected_recompiles')}"
+                    if d.get("buckets") is not None else ""))
+    res = rep["resilience"]
+    if any(v for v in res.values()):
+        L.append(f"resilience: faults {res['faults_injected'] or '{}'}  "
+                 f"strikes {res['spoke_strikes']}  "
+                 f"disabled {res['spokes_disabled']}  "
+                 f"evictions {res['bound_evictions']}  "
+                 f"quarantine resets {res['lane_quarantine_resets']}  "
+                 f"ckpt writes/restores {res['checkpoint_writes']}"
+                 f"/{res['checkpoint_restores']}")
+    for cyl, k in rep["kernel"].items():
+        tot = k.get("pdhg_iterations_total")
+        if tot is not None:
+            L.append(f"kernel[{cyl}]: pdhg iters {tot}  restarts "
+                     f"{k.get('pdhg_restarts_total')}  guard resets "
+                     f"{k.get('pdhg_guard_resets_total')}")
+    if rep["flags"]:
+        L.append("flags:")
+        L.extend(f"  ! {f}" for f in rep["flags"])
+    return "\n".join(L)

@@ -35,8 +35,9 @@ DISPATCH = "dispatch"                  # one coalesced megabatch dispatched
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler session start/stop
+SPAN = "span"                          # one timed wheel phase (host wall)
 RUN_START = "run-start"
-RUN_END = "run-end"
+RUN_END = "run-end"                    # exit reason + final gap
 
 ALL_KINDS = frozenset(v for k, v in list(globals().items())
                       if k.isupper() and isinstance(v, str))

@@ -1,0 +1,265 @@
+###############################################################################
+# Perf-regression compare/gate (ISSUE 5 tentpole, part 3;
+# docs/telemetry.md).
+#
+# `compare` diffs the perf metrics of two artifacts; `gate` applies
+# direction-aware thresholds and fails (exit 2 from the CLI) on a
+# regression — the mechanical guard the ROADMAP north star needs so
+# sec/iter, backend-compile, and time-to-certified-gap regressions are
+# caught by CI instead of by eyeballing BENCH_*.json diffs.
+#
+# Accepted artifact forms (auto-detected per file):
+#   * an analyzer report (telemetry/analyze.py --json output;
+#     schema mpisppy-tpu-analyze/1);
+#   * a BENCH_DETAIL.json-style dict (bench.py output: *_to_1pct_gap
+#     sections, wheel_overhead, measured_mfu, sweep_iters_per_sec,
+#     embedded metrics_snapshot / dispatch stats);
+#   * a BENCH_r0N.json driver wrapper whose `tail` holds the (possibly
+#     front-TRUNCATED) bench stdout: named sections are salvaged by
+#     balanced-brace extraction, so the committed r04/r05 fixtures gate
+#     on their recoverable overlap instead of failing to parse.
+#
+# Metrics are flattened to dotted keys; GATES maps key patterns to
+# (direction, relative threshold).  Only keys present in BOTH artifacts
+# are gated — a metric that disappeared is reported, not failed (bench
+# sections legitimately come and go across rounds).
+###############################################################################
+from __future__ import annotations
+
+import json
+import re
+
+ANALYZE_SCHEMA_PREFIX = "mpisppy-tpu-analyze/"
+
+#: (key regex, direction, relative threshold).  direction "up" = larger
+#: is worse, "down" = smaller is worse.  First match wins; keys that
+#: match nothing are compared but never gated.
+GATES: tuple[tuple[str, str, float], ...] = (
+    (r"(^|\.)sec_per_iter", "up", 0.10),
+    (r"(^|\.)seconds_to_gap$", "up", 0.15),
+    (r"(^|\.)time_to_gap\.", "up", 0.15),
+    (r"(^|\.)iters_per_sec$", "down", 0.10),
+    (r"(^|\.)overhead_factor$", "up", 0.15),
+    (r"backend_compiles", "up", 0.10),
+    (r"unexpected_recompiles", "up", 0.0),
+    (r"guard_resets", "up", 0.0),
+    (r"(^|\.)final_rel_gap$", "up", 0.25),
+)
+
+#: absolute slack added on top of the relative threshold, so integer
+#: counters (compiles, guard resets) tolerate tiny absolute wiggle
+ABS_SLACK = {"backend_compiles": 2.0, "guard_resets": 2.0,
+             "unexpected_recompiles": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# artifact loading + metric extraction
+# ---------------------------------------------------------------------------
+def _salvage_tail(tail: str) -> dict:
+    """Recover named JSON sections from a (front-truncated) bench
+    stdout tail: for every `"name": {` seen, try a balanced-brace parse;
+    also pick up top-level scalars like `"bench_total_sec": 1012.3`.
+    Sections cut off by the truncation simply don't parse and are
+    skipped — salvage is best-effort by design."""
+    out: dict = {}
+    spans: list[tuple[int, int]] = []  # captured section extents
+    for mt in re.finditer(r'"(\w+)":\s*(\{|\[)', tail):
+        if any(a <= mt.start() < b for a, b in spans):
+            continue  # nested inside an already-salvaged section
+        name = mt.group(1)
+        depth, i = 0, mt.end(2) - 1
+        in_str = esc = False
+        for i in range(mt.end(2) - 1, len(tail)):
+            ch = tail[i]
+            if in_str:
+                if esc:
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+                continue
+            if ch == '"':
+                in_str = True
+            elif ch in "{[":
+                depth += 1
+            elif ch in "}]":
+                depth -= 1
+                if depth == 0:
+                    break
+        if depth != 0:
+            continue
+        try:
+            val = json.loads(tail[mt.end(2) - 1:i + 1])
+        except ValueError:
+            continue
+        if name not in out:
+            out[name] = val
+            spans.append((mt.start(), i + 1))
+    # top-level scalars: whitelist only — a bare regex would hoist
+    # NESTED scalars ("seconds_to_gap": ... inside whichever section
+    # survived the truncation) to top level and diff unrelated sections
+    # against each other
+    for key in ("bench_total_sec",):
+        ms = re.search(rf'"{key}":\s*(-?\d+(?:\.\d+)?)', tail)
+        if ms and key not in out:
+            out[key] = float(ms.group(1))
+    return out
+
+
+def load_artifact(path: str) -> dict:
+    """Load + normalize one artifact file into a bench-style dict (or
+    an analyzer report, passed through)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and isinstance(obj.get("tail"), str) \
+            and "cmd" in obj:
+        return _salvage_tail(obj["tail"])
+    return obj
+
+
+def _flatten(prefix: str, obj, out: dict) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(obj, list):
+        # positional keys: bench lists (the scenario sweep) keep a
+        # stable order across rounds
+        for i, v in enumerate(obj):
+            _flatten(f"{prefix}.{i}", v, out)
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+
+
+def extract_metrics(obj: dict) -> dict[str, float]:
+    """Flatten an artifact into {dotted_key: number}.  Analyzer reports
+    keep only the gate-relevant sections (timings, bounds, dispatch,
+    guard totals) so two reports of different runs stay comparable."""
+    out: dict[str, float] = {}
+    schema = obj.get("schema", "") if isinstance(obj, dict) else ""
+    if isinstance(schema, str) and schema.startswith(
+            ANALYZE_SCHEMA_PREFIX):
+        _flatten("iteration", obj.get("iteration") or {}, out)
+        b = obj.get("bounds") or {}
+        for k in ("final_rel_gap", "min_rel_gap"):
+            if isinstance(b.get(k), (int, float)):
+                out[f"bounds.{k}"] = float(b[k])
+        for tgt, hit in (b.get("time_to_gap") or {}).items():
+            if isinstance(hit, dict) and hit.get("seconds") is not None:
+                out[f"time_to_gap.{tgt}"] = float(hit["seconds"])
+        _flatten("dispatch", obj.get("dispatch") or {}, out)
+        for cyl, k in (obj.get("kernel") or {}).items():
+            if isinstance(k, dict) \
+                    and k.get("pdhg_guard_resets_total") is not None:
+                out[f"kernel.{cyl}.guard_resets"] = float(
+                    k["pdhg_guard_resets_total"])
+        out.pop("iteration.count", None)
+        return out
+    _flatten("", obj, out)
+    # noise keys that vary run to run without meaning anything
+    drop = re.compile(r"(t_wall|timestamp|seed|\.n$|\.rc$)")
+    return {k: v for k, v in out.items() if not drop.search(k)}
+
+
+# ---------------------------------------------------------------------------
+# compare + gate
+# ---------------------------------------------------------------------------
+def _gate_for(key: str):
+    for pat, direction, thr in GATES:
+        if re.search(pat, key):
+            return direction, thr
+    return None, None
+
+
+def compare(old: dict, new: dict) -> dict:
+    """Diff two metric dicts (extract_metrics output).  Returns rows
+    for common keys plus the appeared/disappeared key lists."""
+    mo, mn = extract_metrics(old), extract_metrics(new)
+    rows = []
+    for k in sorted(set(mo) & set(mn)):
+        a, b = mo[k], mn[k]
+        delta = b - a
+        rel = delta / abs(a) if a else (0.0 if not delta else float("inf"))
+        direction, thr = _gate_for(k)
+        regressed = False
+        if direction is not None:
+            slack = next((s for pat, s in ABS_SLACK.items()
+                          if pat in k), 0.0)
+            worse = delta if direction == "up" else -delta
+            regressed = worse > thr * abs(a) + slack
+        rows.append({"metric": k, "old": a, "new": b,
+                     "delta": delta, "rel": rel,
+                     "gated": direction is not None,
+                     "direction": direction, "threshold": thr,
+                     "regressed": regressed})
+    return {
+        "schema": "mpisppy-tpu-regress/1",
+        "rows": rows,
+        "common": len(rows),
+        "appeared": sorted(set(mn) - set(mo)),
+        "disappeared": sorted(set(mo) - set(mn)),
+        "regressions": [r for r in rows if r["regressed"]],
+        "ok": not any(r["regressed"] for r in rows),
+    }
+
+
+def gate(old: dict, new: dict,
+         overrides: dict[str, float] | None = None) -> dict:
+    """compare() with per-call threshold overrides ({key substring:
+    relative threshold}).  `ok` is the pass/fail verdict; the CLI maps
+    it to the exit code."""
+    rep = compare(old, new)
+    if overrides:
+        for r in rep["rows"]:
+            for sub, thr in overrides.items():
+                if sub in r["metric"]:
+                    direction = r["direction"] or "up"
+                    a, delta = r["old"], r["delta"]
+                    worse = delta if direction == "up" else -delta
+                    r["gated"] = True
+                    r["direction"] = direction
+                    r["threshold"] = thr
+                    r["regressed"] = worse > thr * abs(a)
+        rep["regressions"] = [r for r in rep["rows"] if r["regressed"]]
+        rep["ok"] = not rep["regressions"]
+    if not rep["rows"]:
+        # two artifacts with NO overlapping metrics cannot certify
+        # anything — fail loudly rather than green-light a vacuous diff
+        rep["ok"] = False
+        rep["error"] = "no common metrics between the two artifacts"
+    return rep
+
+
+def compare_paths(old_path: str, new_path: str) -> dict:
+    return compare(load_artifact(old_path), load_artifact(new_path))
+
+
+def gate_paths(old_path: str, new_path: str,
+               overrides: dict[str, float] | None = None) -> dict:
+    return gate(load_artifact(old_path), load_artifact(new_path),
+                overrides)
+
+
+def render_compare(rep: dict, only_gated: bool = False) -> str:
+    L = []
+    for r in rep["rows"]:
+        if only_gated and not r["gated"]:
+            continue
+        mark = "REGRESSED" if r["regressed"] else (
+            "gated" if r["gated"] else "")
+        L.append(f"{r['metric']:<52} {r['old']:>12.6g} -> "
+                 f"{r['new']:>12.6g}  ({r['rel']:+7.2%})  {mark}".rstrip())
+    if rep["disappeared"]:
+        L.append(f"disappeared: {', '.join(rep['disappeared'][:8])}"
+                 + (" ..." if len(rep["disappeared"]) > 8 else ""))
+    if rep["appeared"]:
+        L.append(f"appeared: {', '.join(rep['appeared'][:8])}"
+                 + (" ..." if len(rep["appeared"]) > 8 else ""))
+    if rep.get("error"):
+        L.append(f"ERROR: {rep['error']}")
+    verdict = "PASS" if rep["ok"] else \
+        f"FAIL ({len(rep['regressions'])} regression(s))"
+    L.append(f"{rep['common']} common metrics; gate: {verdict}")
+    return "\n".join(L)

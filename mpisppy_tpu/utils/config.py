@@ -411,6 +411,17 @@ class Config:
         self.add_to_config("profile_iters",
                            "wheel iterations the profiler trace covers",
                            int, 5)
+        self.add_to_config("flight_recorder",
+                           "always-on crash black box: ring of the last "
+                           "events, dumped to flight-<runid>.jsonl when "
+                           "the wheel dies (disable: "
+                           "--flight-recorder false)", bool, True)
+        self.add_to_config("flight_capacity",
+                           "events held by the flight-recorder ring",
+                           int, 512)
+        self.add_to_config("flight_dir",
+                           "directory flight-<runid>.jsonl dumps land "
+                           "in", str, ".")
 
     def dispatch_args(self):
         """Dispatch-scheduler knobs (docs/dispatch.md): the coalescing

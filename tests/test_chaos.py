@@ -587,3 +587,52 @@ def test_chaos_soak_many_faults(tmp_path):
     assert rel_gap <= 5e-3 + 1e-6
     assert ws.BestInnerBound == pytest.approx(ws0.BestInnerBound, rel=1e-2)
     assert ws.BestOuterBound == pytest.approx(ws0.BestOuterBound, rel=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: every crash leaves a black box, tracing on or off
+# (ISSUE 5; the simulated-preemption path)
+# ---------------------------------------------------------------------------
+def test_flight_recorder_black_box_on_preemption(tmp_path):
+    import json
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.telemetry import analyze as an
+
+    batch = farmer_batch(3)
+    # tracing OFF: the recorder is the bus's only sink — the crash
+    # must still leave a valid flight-<runid>.jsonl
+    bus = telemetry.EventBus()
+    rec = telemetry.FlightRecorder(capacity=64, dump_dir=str(tmp_path))
+    bus.subscribe(rec)
+    ckpt = str(tmp_path / "wheel.npz")
+    plan = FaultPlan(seed=3, preempt_at_iter=4)
+    ws = WheelSpinner(
+        hub_dict(batch, {"telemetry_bus": bus, "fault_plan": plan,
+                         "checkpoint_path": ckpt,
+                         "checkpoint_every_s": 1e9}),
+        [dict(d) for d in BOTH_SPOKES])
+    with pytest.raises(SimulatedPreemption):
+        ws.spin()
+
+    path = tmp_path / f"flight-{ws.spcomm.run_id}.jsonl"
+    assert path.exists(), "crash left no black box"
+    assert rec.dumped_to == str(path)
+    rows = [json.loads(line) for line in open(path)]
+    # header first, then ordinary trace lines (oldest first)
+    assert rows[0]["kind"] == "flight-recorder"
+    assert "SimulatedPreemption" in rows[0]["reason"]
+    seqs = [r["seq"] for r in rows[1:]]
+    assert seqs == sorted(seqs)
+    kinds = {r["kind"] for r in rows[1:]}
+    assert {"hub-iteration", "fault-injected", "run-end"} <= kinds
+    # the run-end record carries the exit reason (ISSUE 5 satellite)
+    end = [r for r in rows if r["kind"] == "run-end"][0]
+    assert end["data"]["reason"] == "preemption"
+    assert "SimulatedPreemption" in end["data"]["error"]
+    # fault events are iteration-stamped, no seq-window heuristics
+    fault = [r for r in rows if r["kind"] == "fault-injected"][0]
+    assert fault["iter"] == 4 and fault["data"]["seam"] == "preemption"
+    # the black box is a first-class analyzer input
+    rep = an.analyze_path(str(path))
+    assert rep["run"]["exit"]["reason"] == "preemption"
+    assert rep["resilience"]["faults_injected"]["preemption"] == 1

@@ -1,0 +1,415 @@
+# Derived observability (ISSUE 5; mpisppy_tpu/telemetry/{analyze,
+# flightrec,regress}.py, tools/check_readme_claims.py): the trace
+# analyzer's typed run model + report, the crash flight recorder's
+# ring/dump semantics and overhead contract, the perf-regression gate
+# over BENCH fixtures and analyzer reports, and the README perf-claim
+# lint — all wired to the `python -m mpisppy_tpu.telemetry` CLI.
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.telemetry import analyze as an
+from mpisppy_tpu.telemetry import regress
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+GOLDEN = os.path.join(HERE, "fixtures", "golden_farmer_trace.jsonl")
+CLI = [sys.executable, "-m", "mpisppy_tpu.telemetry"]
+ENV = {"PATH": "/usr/bin:/bin:/usr/local/bin", "JAX_PLATFORMS": "cpu",
+       "HOME": os.path.expanduser("~")}
+
+
+def farmer_wheel(bus, max_iterations=8, hub_extra=None):
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.cylinders import (
+        LagrangianOuterBound, PHHub, XhatXbarInnerBound,
+    )
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    batch = batch_mod.from_specs(specs)
+    opts = ph_mod.PHOptions(
+        default_rho=1.0, max_iterations=max_iterations, conv_thresh=0.0,
+        subproblem_windows=10, pdhg=pdhg.PDHGOptions(tol=1e-7))
+    hub_opts = {"rel_gap": 5e-3, "telemetry_bus": bus}
+    hub_opts.update(hub_extra or {})
+    hub = {"hub_class": PHHub, "hub_kwargs": {"options": hub_opts},
+           "opt_class": ph_mod.PH,
+           "opt_kwargs": {"options": opts, "batch": batch}}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound,
+         "opt_kwargs": {"options": {}}},
+        {"spoke_class": XhatXbarInnerBound, "opt_kwargs": {"options": {}}},
+    ]
+    return WheelSpinner(hub, spokes).spin()
+
+
+# ---------------------------------------------------------------------------
+# Analyzer: golden-trace round trip (committed fixture of a real
+# farmer wheel with a NaN fault injection + checkpointing)
+# ---------------------------------------------------------------------------
+def test_analyze_golden_trace():
+    rep = an.analyze_path(GOLDEN)
+    assert rep["schema"] == an.ANALYZE_SCHEMA
+    assert rep["run"]["hub_class"] == "PHHub"
+    assert rep["run"]["num_spokes"] == 2
+    # explicit exit verdict (ISSUE 5 satellite: run-end event)
+    assert rep["run"]["exit"]["reason"] == "max-iter"
+    assert rep["run"]["exit"]["rel_gap"] == pytest.approx(7.787e-3,
+                                                          rel=1e-3)
+    # per-phase wall-time breakdown from the span events
+    phases = rep["phases"]
+    assert {"harvest", "hub_sync", "spoke_update", "checkpoint",
+            "subproblem_solve", "iter0_solve"} <= set(phases)
+    assert phases["subproblem_solve"]["calls"] == 10
+    assert all(a["total_s"] >= 0 for a in phases.values())
+    assert abs(sum(a["share"] for a in phases.values()) - 1.0) < 1e-6
+    # iteration timing
+    it = rep["iteration"]
+    assert it["count"] == 11
+    assert it["sec_per_iter_median"] > 0
+    # bound progress + stall diagnostics
+    b = rep["bounds"]
+    assert b["final_outer"] == pytest.approx(-108931.95, rel=1e-4)
+    assert b["final_inner"] == pytest.approx(-108090.27, rel=1e-4)
+    assert b["time_to_gap"]["0.01"]["iter"] == 10
+    assert b["iters_since_outer_moved"] == 4
+    # per-spoke attribution: who produced the binding bounds
+    at = rep["attribution"]
+    assert at["final_bound_producer"]["outer"]["spoke"] == 0
+    assert at["final_bound_producer"]["outer"]["class"] \
+        == "LagrangianOuterBound"
+    assert at["final_bound_producer"]["inner"]["spoke"] == 1
+    s0 = at["spokes"]["0"]
+    assert s0["harvests"] == 11 and s0["rejects"] == 1 \
+        and s0["strikes"] == 1
+    # the injected NaN shows up as cause (fault) AND response (strike)
+    res = rep["resilience"]
+    assert res["faults_injected"] == {"spoke_bound": 1}
+    assert res["spoke_strikes"] == 1 and res["checkpoint_writes"] >= 1
+    # kernel counters folded per cylinder
+    assert rep["kernel"]["hub"]["pdhg_iterations_total"] > 0
+    # the human rendering carries the load-bearing lines
+    text = an.render_report(rep)
+    assert "binding outer: spoke 0 (LagrangianOuterBound)" in text
+    assert "exit: max-iter" in text
+    json.dumps(rep)  # machine report is strict-JSON-able
+
+
+def test_analyze_handles_torn_tail_and_run_selection(tmp_path):
+    rows = open(GOLDEN).read().splitlines()
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text("\n".join(rows) + "\n" + rows[-1][: len(rows[-1]) // 2])
+    rep = an.analyze_path(str(torn))
+    assert rep["run"]["events"] == len(rows)
+    # unknown run id is a clear error, not a silent empty report
+    with pytest.raises(ValueError):
+        an.analyze_path(GOLDEN, run="nonexistent")
+
+
+def test_analyze_cli_json(tmp_path):
+    out = subprocess.run(CLI + ["analyze", "--trace-jsonl", GOLDEN,
+                                "--json"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["schema"] == an.ANALYZE_SCHEMA
+    assert rep["run"]["exit"]["reason"] == "max-iter"
+    # human mode renders the report (not JSON)
+    out2 = subprocess.run(CLI + ["analyze", "--trace-jsonl", GOLDEN],
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=120, env=ENV)
+    assert out2.returncode == 0 and "phases (host wall):" in out2.stdout
+
+
+# ---------------------------------------------------------------------------
+# Analyzer on a live tier-1 wheel run (the acceptance criterion)
+# ---------------------------------------------------------------------------
+def test_analyze_live_wheel_trace(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    bus = telemetry.EventBus()
+    bus.subscribe(telemetry.JsonlSink(path))
+    farmer_wheel(bus, max_iterations=6)
+    bus.close()
+    rep = an.analyze_path(path)
+    assert rep["run"]["exit"]["reason"] in ("converged", "max-iter",
+                                            "conv-thresh", "stalled")
+    assert {"harvest", "subproblem_solve"} <= set(rep["phases"])
+    assert rep["iteration"]["sec_per_iter_median"] > 0
+    producers = rep["attribution"]["final_bound_producer"]
+    assert {"outer", "inner"} <= set(producers)
+    assert rep["flags"] == [] or all(isinstance(f, str)
+                                     for f in rep["flags"])
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: ring semantics, dump format, overhead contract
+# ---------------------------------------------------------------------------
+def test_flight_recorder_ring_wraps_and_dumps(tmp_path):
+    bus = telemetry.EventBus()
+    rec = telemetry.FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    bus.subscribe(rec)
+    run = telemetry.new_run_id()
+    for i in range(20):
+        bus.emit(telemetry.HUB_ITERATION, run=run, cyl="hub",
+                 hub_iter=i, iter=i)
+    assert rec.dropped == 12
+    evs = rec.events()
+    assert len(evs) == 8
+    assert [e.hub_iter for e in evs] == list(range(12, 20))  # oldest first
+    path = rec.dump(reason="unit test")
+    assert path == str(tmp_path / f"flight-{run}.jsonl")
+    rows = [json.loads(line) for line in open(path)]
+    hdr = rows[0]
+    assert hdr["kind"] == "flight-recorder" and hdr["reason"] == "unit test"
+    assert hdr["dumped_events"] == 8 and hdr["dropped"] == 12
+    assert [r["iter"] for r in rows[1:]] == list(range(12, 20))
+    # a dump is an analyzer input; without run-end it reads as truncated
+    rep = an.analyze(an.build_run_model(rows))
+    assert rep["run"]["exit"]["reason"] == "truncated"
+    assert rep["run"]["exit"]["flight_reason"] == "unit test"
+    assert any("truncated" in f for f in rep["flags"])
+
+
+def test_flight_recorder_zero_graph_impact_and_throughput(tmp_path):
+    """Overhead contract: the ring sink is host-side bookkeeping only —
+    the lowered wheel step is byte-identical with a recorder-bearing
+    bus attached (the kernel-counters HLO test's contract extended to
+    the black box), and bus throughput with a recorder stays in the
+    microseconds-per-event regime."""
+    import jax.numpy as jnp
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+    names = farmer.scenario_names_creator(3)
+    specs = [farmer.scenario_creator(nm, num_scens=3) for nm in names]
+    batch = batch_mod.from_specs(specs)
+    opts = ph_mod.kernel_opts(ph_mod.PHOptions(
+        default_rho=1.0, conv_thresh=0.0, subproblem_windows=10,
+        pdhg=pdhg.PDHGOptions(tol=1e-7)))
+    rho = jnp.ones((batch.num_nonants,), batch.qp.c.dtype)
+    st, _, _ = ph_mod.ph_iter0(batch, rho, opts)
+    text_base = ph_mod.ph_iterk.lower(batch, st, opts).as_text()
+
+    bus = telemetry.EventBus()
+    bus.subscribe(telemetry.FlightRecorder(dump_dir=str(tmp_path)))
+    ws = farmer_wheel(bus, max_iterations=3)
+    text_wired = ph_mod.ph_iterk.lower(
+        batch, ws.opt.state, ph_mod.kernel_opts(ws.opt.options)).as_text()
+    assert text_wired == text_base
+
+    # throughput: the ring is a preallocated slot store — no growth,
+    # no per-event allocation of anything but the Event the bus built
+    rec = telemetry.FlightRecorder(capacity=512)
+    bus2 = telemetry.EventBus()
+    bus2.subscribe(rec)
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        bus2.emit(telemetry.HUB_ITERATION, run="r", cyl="hub", hub_iter=i)
+    per_event = (time.perf_counter() - t0) / n
+    assert per_event < 250e-6, f"{per_event * 1e6:.1f} us/event"
+    assert len(rec._ring) == 512 and rec.dropped == n - 512
+
+
+def test_generic_cylinders_crash_leaves_black_box(tmp_path, monkeypatch):
+    """A wheel dying under the CLI driver with tracing OFF still leaves
+    flight-<runid>.jsonl (the always-on registration in
+    generic_cylinders + the dump in WheelSpinner.spin's unwind)."""
+    from mpisppy_tpu import generic_cylinders
+    from mpisppy_tpu.cylinders import hub as hub_mod
+
+    calls = {"n": 0}
+    orig = hub_mod.PHHub._harvest_kernel_counters
+
+    def boom(self):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise RuntimeError("synthetic mid-wheel crash")
+        return orig(self)
+
+    monkeypatch.setattr(hub_mod.PHHub, "_harvest_kernel_counters", boom)
+    args = ["--module-name", "mpisppy_tpu.models.farmer",
+            "--num-scens", "3", "--max-iterations", "6",
+            "--rel-gap", "0.005", "--lagrangian", "--xhatxbar",
+            "--flight-dir", str(tmp_path)]
+    with pytest.raises(RuntimeError, match="synthetic mid-wheel crash"):
+        generic_cylinders.main(args)
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight-") and f.endswith(".jsonl")]
+    assert len(dumps) == 1, dumps
+    rep = an.analyze_path(str(tmp_path / dumps[0]))
+    assert rep["run"]["exit"]["reason"] == "exception"
+    assert "synthetic mid-wheel crash" in rep["run"]["exit"]["error"]
+
+
+# ---------------------------------------------------------------------------
+# Regression gate: BENCH fixtures + analyzer reports
+# ---------------------------------------------------------------------------
+def test_gate_passes_r05_vs_r04_and_fails_on_regression(tmp_path):
+    r04 = os.path.join(REPO, "BENCH_r04.json")
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    rep = regress.gate_paths(r04, r05)
+    assert rep["ok"], rep["regressions"]
+    assert rep["common"] > 10
+    # the salvage recovered gateable keys from the truncated tails
+    gated = {r["metric"] for r in rep["rows"] if r["gated"]}
+    assert any("sec_per_iter" in k for k in gated)
+    assert any("iters_per_sec" in k for k in gated)
+
+    # synthetically regress sec_per_iter by 33% -> gate must fail
+    bad = json.load(open(r05))
+    bad["tail"] = bad["tail"].replace('"sec_per_iter": 0.0601',
+                                     '"sec_per_iter": 0.0801')
+    bad_path = tmp_path / "BENCH_regressed.json"
+    bad_path.write_text(json.dumps(bad))
+    rep2 = regress.gate_paths(r04, str(bad_path))
+    assert not rep2["ok"]
+    assert any("sec_per_iter" in r["metric"] for r in rep2["regressions"])
+    # the CLI maps the verdicts to exit codes (0 pass / 2 regression)
+    from mpisppy_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main(["gate", r04, r05]) == 0
+    assert tel_main(["gate", r04, str(bad_path)]) == 2
+    # direction matters: a 33% FASTER sec_per_iter is not a regression
+    good = json.load(open(r05))
+    good["tail"] = good["tail"].replace('"sec_per_iter": 0.0601',
+                                       '"sec_per_iter": 0.0401')
+    good_path = tmp_path / "BENCH_improved.json"
+    good_path.write_text(json.dumps(good))
+    assert regress.gate_paths(r04, str(good_path))["ok"]
+
+
+def test_gate_analyzer_reports_and_thresholds(tmp_path):
+    rep = an.analyze_path(GOLDEN)
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(rep))
+    # identical reports: everything common, nothing regressed
+    out = regress.gate_paths(str(a), str(a))
+    assert out["ok"] and out["common"] > 3
+
+    worse = json.loads(json.dumps(rep))
+    worse["iteration"]["sec_per_iter_median"] *= 2.0
+    worse["iteration"]["sec_per_iter_p90"] *= 2.0
+    b.write_text(json.dumps(worse))
+    out2 = regress.gate_paths(str(a), str(b))
+    assert not out2["ok"]
+    assert any("sec_per_iter_median" in r["metric"]
+               for r in out2["regressions"])
+    # per-call threshold override loosens the verdict
+    out3 = regress.gate_paths(str(a), str(b),
+                              overrides={"sec_per_iter": 3.0})
+    assert out3["ok"]
+    # metric extraction keyed the gateable fields
+    m = regress.extract_metrics(rep)
+    assert "iteration.sec_per_iter_median" in m
+    assert "time_to_gap.0.01" in m
+    assert "kernel.hub.guard_resets" in m
+
+
+def test_gate_refuses_vacuous_diff():
+    out = regress.gate({"x": {"a": 1.0}}, {"y": {"b": 2.0}})
+    assert not out["ok"] and "no common metrics" in out["error"]
+
+
+def test_bench_tail_salvage_recovers_sections():
+    art = regress.load_artifact(os.path.join(REPO, "BENCH_r04.json"))
+    # the r04 tail is front-truncated; the complete trailing sections
+    # must still be recovered with their nested fields intact
+    assert art["hydro_to_1pct_gap"]["seconds_to_gap"] == \
+        pytest.approx(176.072)
+    assert art["measured_mfu"]["S10000"]["sec_per_iter"] == \
+        pytest.approx(0.0597)
+    assert isinstance(art["sweep_iters_per_sec"], list)
+    # nested sections are not duplicated at top level
+    assert "S10000" not in art
+
+
+# ---------------------------------------------------------------------------
+# Dispatch events join the iteration timeline exactly (ISSUE 5
+# satellite: hub_iter stamps)
+# ---------------------------------------------------------------------------
+def test_dispatch_events_carry_hub_iter_stamp():
+    from mpisppy_tpu import dispatch
+    from mpisppy_tpu.dispatch import DispatchOptions, SolveScheduler
+
+    events = []
+
+    class Grab(telemetry.Sink):
+        def handle(self, event):
+            events.append(event)
+
+    bus = telemetry.EventBus()
+    bus.subscribe(Grab())
+
+    def fake_solve(qp, d_col, int_cols, opts, **kw):
+        return qp.c  # any array with a leading batch axis
+
+    import jax.numpy as jnp
+    import dataclasses as dc
+    from mpisppy_tpu.ops.boxqp import BoxQP
+    S, n, m = 3, 4, 2
+    qp = BoxQP(c=jnp.zeros((S, n)), q=jnp.ones((S, n)),
+               A=jnp.zeros((m, n)), bl=jnp.zeros((S, m)),
+               bu=jnp.ones((S, m)), l=jnp.zeros((S, n)),
+               u=jnp.ones((S, n)))
+    sched = SolveScheduler(DispatchOptions(max_wait_ms=0.1),
+                           solve_fn=fake_solve, bus=bus, run="testrun")
+    try:
+        dispatch.set_hub_iter(-1)   # pre-wheel
+        sched.solve_mip(qp, jnp.ones((n,)), jnp.array([], jnp.int32))
+        dispatch.set_hub_iter(7)    # mid-wheel
+        sched.solve_mip(qp, jnp.ones((n,)), jnp.array([], jnp.int32))
+    finally:
+        sched.close()
+        dispatch.set_hub_iter(-1)
+    disp = [e for e in events if e.kind == telemetry.DISPATCH]
+    assert [e.hub_iter for e in disp] == [-1, 7]
+    # and the stamp survives serialization for the analyzer's join
+    rows = [json.loads(e.to_json()) for e in disp]
+    assert rows[0]["iter"] == -1 and rows[1]["iter"] == 7
+
+
+# ---------------------------------------------------------------------------
+# README perf-claim lint (tier-1, next to lint_no_print)
+# ---------------------------------------------------------------------------
+def _claims_tool():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_readme_claims
+    finally:
+        sys.path.pop(0)
+    return check_readme_claims
+
+
+def test_readme_claims_trace_to_artifacts():
+    tool = _claims_tool()
+    assert tool.find_violations() == []
+
+
+def test_readme_claims_lint_catches_drift(tmp_path):
+    tool = _claims_tool()
+    fake = tmp_path / "README.md"
+    fake.write_text(
+        "intro prose\n\n"
+        "Measured on one TPU v5 lite chip:\n\n"
+        "- reaches the gap in 999 s (12 iterations) at ~3.1x speedup\n"
+        "- config noise: 900 scenarios, 3-stage tree\n\n"
+        "Out of scope: nothing.\n")
+    pool = {12.0, 3.05}
+    vio = tool.find_violations(readme=str(fake), pool=pool)
+    # 999 s has no witness; 12 iterations does; ~3.1x matches 3.05
+    # within the approximation slack; config numbers are not claims
+    assert len(vio) == 1 and "'999s'" in vio[0]
+    assert tool.find_violations(readme=str(fake),
+                                pool=pool | {998.9}) == []

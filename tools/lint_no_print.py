@@ -25,6 +25,8 @@ LIB_ROOT = os.path.join(os.path.dirname(os.path.dirname(
 ALLOWED_FILES = {
     "telemetry/console.py",   # the console sink of last resort
     "telemetry/sinks.py",     # ConsoleSink rendering
+    "telemetry/__main__.py",  # trace-toolbox CLI (its stdout IS the
+                              # product: reports + JSON)
     "__main__.py",            # CLI entry point
     "parallel/_multihost_dryrun.py",  # multihost smoke entry point
     "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
