@@ -583,11 +583,18 @@ def bench_hydro():
 
 
 def bench_measured_mfu():
-    """VERDICT r3 weak #6: measured (not modeled) FLOP/s and HBM
-    bandwidth for the PH step.  Uses XLA's compiled cost analysis
+    """VERDICT r3 weak #6 / ISSUE 7: measured (not modeled) FLOP/s and
+    HBM bandwidth for the PH step.  Uses XLA's compiled cost analysis
     (flops + bytes accessed of the EXACT program run) divided by
-    measured wall-clock, alongside the analytic matvec model, plus a
-    jax.profiler device trace saved as an artifact."""
+    measured wall-clock, PLUS the trace-derived device profile
+    (telemetry/deviceprof.py + roofline.py) computed from the
+    jax.profiler capture of one steady-state iteration: achieved HBM
+    GB/s against the device's own peak, sustained stream bandwidth of
+    the HBM-dominated movement ops, DMA/compute overlap fraction, and
+    device sec/iter.  The round-5 hand-rolled two-op (matvec + saxpy)
+    microbenchmarks are retired: the capture that was already saved as
+    an artifact IS the measurement now, and the same numbers gate in
+    CI (`telemetry gate`, docs/telemetry.md)."""
     import jax
     import jax.numpy as jnp
 
@@ -634,80 +641,35 @@ def bench_measured_mfu():
         dt = (time.perf_counter() - t0) / n
         model_flops = _flops_per_ph_iter(batch, opts)
 
-        # hot-op microbenchmarks at the EXACT bench shapes — genuinely
-        # measured achieved rates (the cost-analysis figures above count
-        # while/fori loop bodies ONCE, so they undercount by the
-        # iteration trip count; these do not)
-        # Hot-op reps run INSIDE one dispatch (lax.fori_loop): the axon
-        # tunnel adds ~6 ms RPC latency per dispatch (measured), which
-        # swamped per-op timings in round 4 (0.42 TF "matvec" at S=10k
-        # was mostly tunnel latency, not device time).  K scales
-        # inversely with per-iteration work so the residual
-        # (~6 ms / K) stays under ~2% of the chain's device time at
-        # every scale.
-        K_INLOOP = 400 if S <= 20_000 else 50
-        A = batch.qp.A
-        if hasattr(A, "k"):
-            mm = None  # ELL path: matvec is gather-based, not a GEMM
-        else:
-            AT = jnp.asarray(A).T
-            A_ = jnp.asarray(A)
-
-            @jax.jit
-            def matvec_chain(X, y):
-                def body(_, carry):
-                    x2, _ = carry
-                    y2 = jax.lax.dot_general(
-                        x2, AT, (((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.HIGHEST)
-                    x3 = jax.lax.dot_general(
-                        y2, A_, (((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.HIGHEST)
-                    return x3, y2
-                return jax.lax.fori_loop(
-                    0, K_INLOOP, body, (X, y))
-
-            x2, y2 = matvec_chain(state.solver.x, state.solver.y)
-            jax.block_until_ready(x2)
-            reps = 3
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                x2, y2 = matvec_chain(x2, y2)
-            jax.block_until_ready(x2)
-            mv_dt = (time.perf_counter() - t0) / (reps * K_INLOOP)
-            mm_flops = 4.0 * S * A.shape[-2] * A.shape[-1]
-            mm = round(mm_flops / mv_dt / 1e12, 3)
-
-        @jax.jit
-        def saxpy_chain(a, b):
-            return jax.lax.fori_loop(
-                0, K_INLOOP, lambda _, c: c * 1.0001 + b, a)
-
-        a, b = state.solver.x, state.solver.x_sum
-        c_ = saxpy_chain(a, b)
-        jax.block_until_ready(c_)
-        reps = 3
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            c_ = saxpy_chain(c_, b)
-        jax.block_until_ready(c_)
-        sx_dt = (time.perf_counter() - t0) / (reps * K_INLOOP)
-        stream_gbps = round(3.0 * a.size * a.dtype.itemsize / sx_dt / 1e9,
-                            1)
-
-        out[f"S{S}"] = {
+        entry = {
             "sec_per_iter": round(dt, 4),
             "xla_flops_per_iter_body_once": flops,
             "xla_bytes_per_iter_body_once": bytes_acc,
             "model_tflops": round(model_flops / dt / 1e12, 3),
-            "measured_matvec_tflops": mm,
-            "measured_stream_gbps": stream_gbps,
             "trace_dir": trace_dir,
         }
+        # trace-derived device profile: parse the capture just written
+        # (stdlib-only, no TF/protobuf; telemetry/roofline.py defines
+        # every metric).  measured_stream_gbps is hoisted to the entry
+        # top level so r0N-over-r0N gates keep comparing the same key
+        # the two-op estimate used to fill.
+        try:
+            from mpisppy_tpu.telemetry import roofline
+            dev = roofline.roofline_path(trace_dir)
+            entry["device_profile"] = dev
+            entry["measured_stream_gbps"] = dev.get(
+                "measured_stream_gbps")
+        except (OSError, ValueError) as e:
+            entry.setdefault("device_profile_error", repr(e))
+        out[f"S{S}"] = entry
     out["note"] = ("xla_*_body_once are compiled cost-analysis figures "
                    "that count loop bodies once (no trip-count fold); "
-                   "measured_matvec_tflops / measured_stream_gbps are "
-                   "direct timings of the two hot ops at bench shapes")
+                   "measured_stream_gbps and the device_profile "
+                   "section are derived from the committed "
+                   "jax.profiler capture by telemetry/roofline.py "
+                   "(stream = HBM-dominated data-movement ops; "
+                   "overlap_frac = DMA in-flight time hidden behind "
+                   "compute)")
     # v5e single-chip peaks for context (public spec)
     out["v5e_peak_bf16_tflops"] = 197.0
     out["v5e_peak_hbm_gbps"] = 819.0

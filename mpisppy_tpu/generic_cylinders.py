@@ -389,6 +389,40 @@ def _do_decomp(cfg, module):
         telemetry.close_bus(tel_bus)
 
 
+def _report_device_profile(profile_dir: str) -> None:
+    """A --profile-dir run closes the loop itself (ISSUE 7): parse the
+    capture the ProfilerSession just wrote, print the headline device
+    numbers, and leave the full roofline report next to the capture as
+    device_profile.json — the committed-artifact form the README lint
+    and `telemetry gate` consume."""
+    import os
+
+    from mpisppy_tpu.telemetry import deviceprof, roofline
+    try:
+        cap = deviceprof.newest_capture(profile_dir)
+        if cap is None:
+            return
+        rep = roofline.roofline(deviceprof.build_timeline(cap))
+    except (OSError, ValueError) as e:
+        global_toc(f"device profile unreadable under {profile_dir}: {e}",
+                   True)
+        return
+    out_path = os.path.join(profile_dir, "device_profile.json")
+    try:
+        from mpisppy_tpu.utils.atomic_io import atomic_write_text
+        atomic_write_text(out_path, json.dumps(rep, indent=1) + "\n")
+    except OSError:
+        out_path = "(unwritable)"
+    def _g(v):
+        return "-" if v is None else format(v, ".4g")
+    global_toc(
+        f"device profile: sec/iter {_g(rep.get('device_sec_per_iter'))}"
+        f"  stream {_g(rep.get('measured_stream_gbps'))} GB/s"
+        f"  hbm {_g(rep.get('achieved_hbm_gbps'))}/"
+        f"{_g(rep.get('peak_hbm_gbps'))} GB/s"
+        f"  overlap {_g(rep.get('overlap_frac'))}  -> {out_path}", True)
+
+
 def _spin_and_report(cfg, module, hub, spokes, names, specs):
     wheel = WheelSpinner(hub, spokes)
     ckpt = cfg.get("checkpoint_path")
@@ -422,6 +456,8 @@ def _spin_and_report(cfg, module, hub, spokes, names, specs):
     global_toc(
         f"outer {wheel.BestOuterBound:.6g} inner {wheel.BestInnerBound:.6g}"
         f" rel_gap {rel_gap:.3e}", True)
+    if cfg.get("profile_dir"):
+        _report_device_profile(cfg["profile_dir"])
     if cfg.get("solution_base_name"):
         wheel.write_first_stage_solution(
             cfg["solution_base_name"] + ".csv")

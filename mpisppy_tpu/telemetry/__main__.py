@@ -3,13 +3,23 @@
 # toolbox CLI (ISSUE 5; docs/telemetry.md).  Pure host-side stdlib: runs
 # on any machine holding a trace, no jax required.
 #
-#   analyze --trace-jsonl T [--run ID] [--json]
+#   analyze [--trace-jsonl T] [--profile-dir D] [--run ID] [--json]
 #       per-phase wall-time breakdown, bound progress + stalls,
 #       per-spoke bound attribution, dispatch audit, crash forensics —
 #       T may be a --trace-jsonl stream OR a flight-<runid>.jsonl dump.
+#       --profile-dir joins the DEVICE section (trace-derived roofline:
+#       measured_stream_gbps, achieved HBM GB/s, MFU, DMA/compute
+#       overlap_frac) from a jax.profiler capture; with --trace-jsonl
+#       alone, a capture advertised by the run's `profile` events is
+#       auto-discovered.  --profile-dir alone renders the device-only
+#       report.
+#   watch --trace-jsonl T [--metrics-snapshot M] [--interval S] [--once]
+#       live-tail a RUNNING wheel: bound/gap, sec/iter, dispatch
+#       occupancy, quarantine counts; --once prints one snapshot.
 #   compare OLD NEW [--json]
 #       diff the perf metrics of two artifacts (analyzer --json
-#       reports, BENCH_DETAIL.json, or BENCH_r0N.json wrappers).
+#       reports, device roofline reports, BENCH_DETAIL.json, or
+#       BENCH_r0N.json wrappers).
 #   gate OLD NEW [--threshold KEY=FRAC ...] [--json]
 #       compare + direction-aware thresholds; exit 2 on a regression.
 ###############################################################################
@@ -26,14 +36,31 @@ def _build_parser() -> argparse.ArgumentParser:
         description="wheel trace analyzer / perf-regression gate")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    pa = sub.add_parser("analyze", help="analyze a JSONL wheel trace")
-    pa.add_argument("--trace-jsonl", required=True,
+    pa = sub.add_parser("analyze", help="analyze a JSONL wheel trace "
+                                        "and/or a device capture")
+    pa.add_argument("--trace-jsonl", default=None,
                     help="trace file (--trace-jsonl output or a "
                          "flight-<runid>.jsonl black box)")
+    pa.add_argument("--profile-dir", default=None,
+                    help="jax.profiler capture dir (--profile-dir of "
+                         "the run, or bench.py's profile_trace_S*): "
+                         "adds the trace-derived device section")
     pa.add_argument("--run", default=None,
                     help="run id to analyze (default: last in stream)")
     pa.add_argument("--json", action="store_true",
                     help="machine report instead of the human rendering")
+
+    pw = sub.add_parser("watch", help="live-tail a running wheel's "
+                                      "trace + metrics snapshot")
+    pw.add_argument("--trace-jsonl", required=True,
+                    help="the running wheel's --trace-jsonl path")
+    pw.add_argument("--metrics-snapshot", default=None,
+                    help="the wheel's --metrics-snapshot file "
+                         "(Prometheus text) to fold into the display")
+    pw.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period, seconds (default 2)")
+    pw.add_argument("--once", action="store_true",
+                    help="print one status snapshot and exit")
 
     for name, hlp in (("compare", "diff two perf artifacts"),
                       ("gate", "compare + thresholds; exit 2 on "
@@ -54,13 +81,32 @@ def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "analyze":
         from mpisppy_tpu.telemetry import analyze as an
+        if not args.trace_jsonl and not args.profile_dir:
+            print("analyze: need --trace-jsonl and/or --profile-dir",
+                  file=sys.stderr)
+            return 1
         try:
-            rep = an.analyze_path(args.trace_jsonl, run=args.run)
+            if args.trace_jsonl:
+                rep = an.analyze_path(args.trace_jsonl, run=args.run,
+                                      profile_dir=args.profile_dir)
+                text = an.render_report(rep)
+            else:
+                # device-only: the roofline report straight from the
+                # capture (the ISSUE 7 acceptance path)
+                from mpisppy_tpu.telemetry import roofline
+                rep = roofline.roofline_path(args.profile_dir)
+                text = roofline.render_device(rep)
         except (OSError, ValueError) as e:
             print(f"analyze: {e}", file=sys.stderr)
             return 1
-        print(json.dumps(rep) if args.json else an.render_report(rep))
+        print(json.dumps(rep) if args.json else text)
         return 0
+
+    if args.cmd == "watch":
+        from mpisppy_tpu.telemetry import watch as w
+        return w.watch(args.trace_jsonl,
+                       metrics_path=args.metrics_snapshot,
+                       interval=args.interval, once=args.once)
 
     from mpisppy_tpu.telemetry import regress
     overrides = {}

@@ -100,6 +100,7 @@ class RunModel:
     dispatch_stats: list = dataclasses.field(default_factory=list)
     kernel: dict = dataclasses.field(default_factory=dict)  # cyl -> last
     spoke_classes: dict = dataclasses.field(default_factory=dict)
+    profiles: list = dataclasses.field(default_factory=list)  # profile evs
 
     def iter_of(self, it: int) -> HubIter:
         if it not in self.iters:
@@ -191,6 +192,8 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
         elif kind == ev.KERNEL_COUNTERS:
             m.kernel["hub" if r.get("cyl") in (None, "", "hub")
                      else r["cyl"]] = data
+        elif kind == ev.PROFILE:
+            m.profiles.append({"iter": it, **data})
     return m
 
 
@@ -447,8 +450,77 @@ def analyze(model: RunModel) -> dict:
     return rep
 
 
-def analyze_path(path: str, run: str | None = None) -> dict:
-    return analyze(build_run_model(load_trace(path), run=run))
+def profiled_window(model: RunModel) -> dict | None:
+    """The hub-iteration window a ProfilerSession captured, from its
+    profile events (start / stop-with-capture), plus the profile dir —
+    the join between the host-span timeline and the device trace."""
+    if not model.profiles:
+        return None
+    out: dict = {"profile_dir": None, "start_iter": None,
+                 "stop_iter": None, "captured": False}
+    for p in model.profiles:
+        if p.get("profile_dir"):
+            out["profile_dir"] = p["profile_dir"]
+        if p.get("action") == "start":
+            out["start_iter"] = p.get("iter")
+        elif p.get("action") in ("stop", "captured"):
+            # a close()-time capture (wheel finalized early) carries
+            # iter=None — keep the last known boundary instead
+            if p.get("iter") is not None:
+                out["stop_iter"] = p["iter"]
+        if p.get("action") == "captured" or p.get("trace_dir"):
+            out["captured"] = True
+            out["capture_dir"] = p.get("trace_dir")
+    return out
+
+
+def attach_device(rep: dict, profile_dir: str) -> dict:
+    """Join a device-trace roofline report (telemetry/roofline.py) onto
+    an analyzer report under rep['device'].  Parse problems become a
+    flag, not a crash — a host report must survive a torn capture."""
+    from mpisppy_tpu.telemetry import roofline
+    try:
+        dev = roofline.roofline_path(profile_dir)
+    except (OSError, ValueError) as e:
+        rep.setdefault("flags", []).append(
+            f"device trace unreadable under {profile_dir!r}: {e}")
+        return rep
+    rep["device"] = dev
+    host_spi = (rep.get("iteration") or {}).get("sec_per_iter_median")
+    dev_spi = dev.get("device_sec_per_iter")
+    if host_spi and dev_spi:
+        # host sec/iter covers dispatch + python; the gap to device
+        # time is the wheel's host-side overhead during the profiled
+        # window
+        rep["device"]["host_device_ratio"] = round(host_spi / dev_spi, 3)
+    return rep
+
+
+def analyze_path(path: str, run: str | None = None,
+                 profile_dir: str | None = None) -> dict:
+    """Analyze a JSONL trace; `profile_dir` (or a profile event in the
+    trace pointing at a directory that exists here) joins the device
+    section on."""
+    model = build_run_model(load_trace(path), run=run)
+    rep = analyze(model)
+    window = profiled_window(model)
+    if window:
+        rep["profiled_window"] = window
+    if profile_dir is None and window and window.get("captured"):
+        # auto-discovery trusts only a VERIFIED capture advertisement
+        # (action "captured"): a bare profile_dir may hold a STALE
+        # capture from an earlier run whose device numbers would be
+        # silently joined to this one.  Prefer the exact capture dir
+        # the event recorded over "newest under the root".
+        import os
+        for cand in (window.get("capture_dir"),
+                     window.get("profile_dir")):
+            if cand and os.path.isdir(cand):
+                profile_dir = cand
+                break
+    if profile_dir:
+        attach_device(rep, profile_dir)
+    return rep
 
 
 # ---------------------------------------------------------------------------
@@ -531,6 +603,19 @@ def render_report(rep: dict) -> str:
             L.append(f"kernel[{cyl}]: pdhg iters {tot}  restarts "
                      f"{k.get('pdhg_restarts_total')}  guard resets "
                      f"{k.get('pdhg_guard_resets_total')}")
+    if rep.get("device"):
+        from mpisppy_tpu.telemetry import roofline
+        L.append("device (trace-derived; docs/telemetry.md):")
+        w = rep.get("profiled_window") or {}
+        if w.get("start_iter") is not None:
+            L.append(f"  profiled hub iters [{w.get('start_iter')}, "
+                     f"{w.get('stop_iter')})")
+        L.extend("  " + ln
+                 for ln in roofline.render_device(rep["device"])
+                 .splitlines())
+        ratio = rep["device"].get("host_device_ratio")
+        if ratio is not None:
+            L.append(f"  host/device sec-per-iter ratio {ratio}")
     if rep["flags"]:
         L.append("flags:")
         L.extend(f"  ! {f}" for f in rep["flags"])

@@ -1,0 +1,523 @@
+###############################################################################
+# Device-trace ingestion (ISSUE 7 tentpole, part 1; docs/telemetry.md).
+#
+# ProfilerSession (profiler.py) and bench.py's jax.profiler.trace write
+# TensorBoard-layout captures:
+#
+#   <profile_dir>/plugins/profile/<YYYY_MM_DD_HH_MM_SS>/
+#       <host>.trace.json.gz      chrome-trace event list
+#       <host>.xplane.pb          raw XSpace protobuf (richer stats)
+#
+# This module turns a capture into a typed DEVICE timeline — per-kernel
+# device durations, DMA (HBM<->VMEM / host copy) in-flight spans,
+# step/host annotations — with two stdlib-only readers:
+#
+#   * the chrome trace.json.gz (gzip+json) is the primary input: every
+#     device op arrives with ts/dur and XLA's `bytes_accessed` /
+#     `hlo_category` args;
+#   * the sibling .xplane.pb, WHEN PRESENT, is read by a hand-rolled
+#     protobuf wire-format walker (no tensorflow, no protobuf runtime —
+#     varint/length-delimited decoding is ~40 lines) to recover what
+#     the json converter drops: the per-op `memory_access_breakdown`
+#     (bytes split by memory space — space 1 is HBM, space 3 on-chip
+#     VMEM on the v5e captures this repo commits), per-op `flops`, and
+#     the device's own `peak_hbm_bw_gigabytes_per_second` /
+#     `peak_teraflops_per_second` plane stats.
+#
+# Why both: `bytes_accessed` alone counts VMEM-resident reuse (ops at
+# S=10k appear to "stream" 2+ TB/s, far over the 819 GB/s HBM roofline),
+# so honest roofline attribution (telemetry/roofline.py) needs the
+# HBM-space split whenever the xplane sidecar survives.  Captures are
+# committed with both files; the json-only path stays supported for
+# trimmed fixtures and foreign traces.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import os
+import re
+import struct
+
+DEVICE_PROCESS_PREFIX = "/device:"
+HOST_PROCESS_PREFIX = "/host:"
+
+#: chrome-trace thread names the profiler gives device lines
+OPS_LINE = "XLA Ops"
+MODULES_LINE = "XLA Modules"
+STEPS_LINE = "Steps"
+ASYNC_LINE = "Async XLA Ops"
+
+#: hlo_category values that are CONTAINER shells: their interval spans
+#: their children (also listed), so byte/time sums must exclude them
+CONTAINER_CATEGORIES = frozenset({"while", "conditional", "call"})
+
+#: async-DMA bookkeeping categories: the -start op queues the transfer
+#: (~ns duration), the -done op is the completion fence; the transfer
+#: itself is IN FLIGHT between them, concurrent with whatever executes
+DMA_START_CATEGORIES = frozenset({"copy-start", "async-start",
+                                  "send", "collective-permute-start"})
+DMA_DONE_CATEGORIES = frozenset({"copy-done", "async-done",
+                                 "recv-done", "collective-permute-done"})
+DMA_CATEGORIES = DMA_START_CATEGORIES | DMA_DONE_CATEGORIES
+
+_DMA_START_RE = re.compile(r"^(.*)-start(\.\d+)?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceOp:
+    """One executed device op (one chrome-trace X event)."""
+
+    name: str
+    category: str
+    start_us: float
+    dur_us: float
+    bytes_accessed: int = 0        # all memory spaces (XLA cost model)
+    hbm_bytes: int | None = None   # space-1 bytes (xplane sidecar only)
+    onchip_bytes: int | None = None
+    flops: int | None = None
+
+    @property
+    def end_us(self) -> float:
+        return self.start_us + self.dur_us
+
+
+@dataclasses.dataclass(frozen=True)
+class DmaSpan:
+    """One async transfer, from its -start op to its -done fence."""
+
+    name: str
+    start_us: float
+    end_us: float
+    bytes: int = 0
+    hbm_bytes: int | None = None
+
+    @property
+    def dur_us(self) -> float:
+        return self.end_us - self.start_us
+
+
+@dataclasses.dataclass(frozen=True)
+class StepMarker:
+    """A StepTraceAnnotation span (profiler.step): one wheel iteration
+    as seen by the device.  `step_num` is the hub_iter the wheel passed
+    in — the join key back to the JSONL host trace."""
+
+    name: str
+    start_us: float
+    dur_us: float
+    step_num: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSpan:
+    """A named host-thread span (TraceAnnotation / python tracer)."""
+
+    name: str
+    start_us: float
+    dur_us: float
+
+
+@dataclasses.dataclass
+class DeviceTimeline:
+    """Typed model of one capture."""
+
+    trace_path: str
+    xplane_path: str | None = None
+    device_name: str = ""
+    modules: list = dataclasses.field(default_factory=list)
+    ops: list = dataclasses.field(default_factory=list)
+    dma: list = dataclasses.field(default_factory=list)
+    steps: list = dataclasses.field(default_factory=list)
+    host_spans: list = dataclasses.field(default_factory=list)
+    peak_hbm_gbps: float | None = None
+    peak_tflops: float | None = None
+
+    @property
+    def has_memory_spaces(self) -> bool:
+        return any(op.hbm_bytes is not None for op in self.ops)
+
+
+# ---------------------------------------------------------------------------
+# capture discovery
+# ---------------------------------------------------------------------------
+def discover_captures(profile_dir: str) -> list[dict]:
+    """All captures under a --profile-dir, oldest -> newest.  Each entry
+    is {"dir", "trace", "xplane"(or None)}.  Accepts the profile root,
+    a single capture dir, or a trace.json.gz path directly."""
+    if os.path.isfile(profile_dir):
+        d = os.path.dirname(profile_dir)
+        return [{"dir": d, "trace": profile_dir,
+                 "xplane": _sibling_xplane(profile_dir)}]
+    roots = [os.path.join(profile_dir, "plugins", "profile"), profile_dir]
+    caps: list[dict] = []
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        for sub in sorted(os.listdir(root)):
+            d = os.path.join(root, sub)
+            if not os.path.isdir(d):
+                continue
+            traces = sorted(f for f in os.listdir(d)
+                            if f.endswith(".trace.json.gz")
+                            or f.endswith(".trace.json"))
+            if traces:
+                t = os.path.join(d, traces[0])
+                caps.append({"dir": d, "trace": t,
+                             "xplane": _sibling_xplane(t)})
+        if caps:
+            break
+        # the profile root may itself hold a capture's files
+        traces = sorted(f for f in os.listdir(root)
+                        if f.endswith(".trace.json.gz")
+                        or f.endswith(".trace.json"))
+        if traces:
+            t = os.path.join(root, traces[0])
+            caps.append({"dir": root, "trace": t,
+                         "xplane": _sibling_xplane(t)})
+            break
+    # timestamped dir names (YYYY_MM_DD_HH_MM_SS) sort chronologically
+    return caps
+
+
+def newest_capture(profile_dir: str) -> dict | None:
+    caps = discover_captures(profile_dir)
+    return caps[-1] if caps else None
+
+
+def _sibling_xplane(trace_path: str) -> str | None:
+    base = trace_path
+    for suf in (".trace.json.gz", ".trace.json"):
+        if base.endswith(suf):
+            base = base[:-len(suf)]
+            break
+    xp = base + ".xplane.pb"
+    return xp if os.path.isfile(xp) else None
+
+
+# ---------------------------------------------------------------------------
+# chrome trace reader (primary)
+# ---------------------------------------------------------------------------
+def load_chrome_trace(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def build_timeline(capture, xplane: str | None = None) -> DeviceTimeline:
+    """Capture -> DeviceTimeline.  `capture` is a discover_captures()
+    entry, a capture dir / profile root, or a trace path."""
+    if isinstance(capture, str):
+        cap = newest_capture(capture)
+        if cap is None:
+            raise ValueError(f"no trace.json.gz capture under {capture!r}")
+        capture = cap
+    trace_path = capture["trace"]
+    xplane = xplane if xplane is not None else capture.get("xplane")
+    raw = load_chrome_trace(trace_path)
+    events = raw.get("traceEvents", raw if isinstance(raw, list) else [])
+    pnames: dict = {}
+    tnames: dict = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pnames[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tnames[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    tl = DeviceTimeline(trace_path=trace_path, xplane_path=xplane)
+    dev_pids = {p for p, n in pnames.items()
+                if n.startswith(DEVICE_PROCESS_PREFIX)}
+    if dev_pids:
+        tl.device_name = pnames[sorted(dev_pids)[0]]
+    side = _read_xplane_sidecar(xplane) if xplane else None
+    if side:
+        tl.peak_hbm_gbps = side.get("peak_hbm_gbps")
+        tl.peak_tflops = side.get("peak_tflops")
+    stats = (side or {}).get("ops", {})
+    raw_ops: list[DeviceOp] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid, tid = e.get("pid"), e.get("tid")
+        line = tnames.get((pid, tid), "")
+        name = e.get("name", "")
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        if pid in dev_pids:
+            if line == MODULES_LINE:
+                tl.modules.append(DeviceOp(
+                    name=name, category="module", start_us=ts,
+                    dur_us=dur))
+            elif line == STEPS_LINE:
+                tl.steps.append(StepMarker(
+                    name=name, start_us=ts, dur_us=dur,
+                    step_num=_step_num(e)))
+            elif line == ASYNC_LINE:
+                # in-flight transfer spans straight from the profiler
+                a = e.get("args", {})
+                st = stats.get(name)
+                tl.dma.append(DmaSpan(
+                    name=name, start_us=ts, end_us=ts + dur,
+                    bytes=_int_arg(a, "bytes_accessed"),
+                    hbm_bytes=st.hbm_bytes if st else None))
+            elif line == OPS_LINE or not line:
+                a = e.get("args", {})
+                st = stats.get(name)
+                raw_ops.append(DeviceOp(
+                    name=name,
+                    category=a.get("hlo_category", "?"),
+                    start_us=ts, dur_us=dur,
+                    bytes_accessed=_int_arg(a, "bytes_accessed"),
+                    hbm_bytes=st.hbm_bytes if st else None,
+                    onchip_bytes=st.onchip_bytes if st else None,
+                    flops=st.flops if st else None))
+        elif pnames.get(pid, "").startswith(HOST_PROCESS_PREFIX):
+            tl.host_spans.append(HostSpan(name=name, start_us=ts,
+                                          dur_us=dur))
+    tl.ops = sorted(raw_ops, key=lambda o: o.start_us)
+    if not tl.dma:
+        tl.dma = _pair_dma(tl.ops)
+    tl.dma.sort(key=lambda d: d.start_us)
+    tl.modules.sort(key=lambda m: m.start_us)
+    tl.steps.sort(key=lambda s: s.start_us)
+    return tl
+
+
+def _int_arg(args: dict, key: str) -> int:
+    try:
+        return int(args.get(key, 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _step_num(e: dict) -> int | None:
+    a = e.get("args", {})
+    for key in ("step_num", "group_id"):
+        if key in a:
+            try:
+                return int(a[key])
+            except (TypeError, ValueError):
+                pass
+    m = re.search(r"(\d+)$", e.get("name", ""))
+    return int(m.group(1)) if m else None
+
+
+def _pair_dma(ops: list) -> list:
+    """Fallback DMA spans from the ops line: match each `X-done.N`
+    fence to its `X-start.N` queue op (FIFO per name when an op
+    executes repeatedly inside a loop)."""
+    starts: dict[str, list] = {}
+    for op in ops:
+        if op.category in DMA_START_CATEGORIES \
+                and _DMA_START_RE.match(op.name):
+            starts.setdefault(op.name, []).append(op)
+    spans = []
+    for op in ops:
+        if op.category not in DMA_DONE_CATEGORIES:
+            continue
+        sname = op.name.replace("-done", "-start")
+        queue = starts.get(sname)
+        if not queue:
+            continue
+        cand = [s for s in queue if s.start_us <= op.start_us]
+        if not cand:
+            continue
+        s = cand[0]     # FIFO: transfers complete in issue order
+        queue.remove(s)
+        spans.append(DmaSpan(name=sname, start_us=s.start_us,
+                             end_us=op.end_us,
+                             bytes=s.bytes_accessed,
+                             hbm_bytes=s.hbm_bytes))
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# xplane sidecar reader — stdlib protobuf wire-format walker
+# ---------------------------------------------------------------------------
+# Message shapes used (tensorflow/profiler xplane.proto, stable since
+# 2020; decoded schemalessly so a missing field degrades to None):
+#   XSpace.planes = 1
+#   XPlane: id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+#           stats=6
+#   XLine: id=1 name=2 events=4 (timestamps also at 6/7 — unused here)
+#   XEvent: metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+#   XEventMetadata: id=1 name=2 display_name=4 stats=5
+#   XStatMetadata: id=1 name=2
+#   XStat: metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+#   memory_access_breakdown bytes payload: repeated MemoryAccessed=1
+#     {operation_type=1 memory_space=2 bytes_accessed=3}
+
+#: memory_access_breakdown space id observed to be HBM on v5e captures
+#: (space 3 is on-chip; see module docstring)
+HBM_MEMORY_SPACE = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class _OpStats:
+    hbm_bytes: int | None = None
+    onchip_bytes: int | None = None
+    flops: int | None = None
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not (b & 0x80):
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) triples of one message.
+    Raises on malformed input — callers treat that as 'no sidecar'."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            if i + ln > n:
+                raise ValueError("truncated length-delimited field")
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            if i + 4 > n:
+                raise ValueError("truncated fixed32 field")
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            if i + 8 > n:
+                raise ValueError("truncated fixed64 field")
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _first(fs, fn, default=None):
+    for f, _, v in fs:
+        if f == fn:
+            return v
+    return default
+
+
+def _stat_value(sf):
+    """XStat -> python value (double/uint64/int64/str/bytes)."""
+    for f, wt, v in sf:
+        if f == 2 and wt == 1:
+            return struct.unpack("<d", v)[0]
+        if f == 3 and wt == 0:
+            return v
+        if f == 4 and wt == 0:
+            # int64 varints are two's-complement over 64 bits
+            return v - (1 << 64) if v >= (1 << 63) else v
+        if f == 5 and wt == 2:
+            return v.decode("utf-8", "replace")
+        if f == 6 and wt == 2:
+            return v
+    return None
+
+
+def _short_name(em_fields) -> str:
+    disp = _first(em_fields, 4)
+    if isinstance(disp, bytes) and disp:
+        return disp.decode("utf-8", "replace")
+    nm = _first(em_fields, 2, b"")
+    nm = nm.decode("utf-8", "replace") if isinstance(nm, bytes) else ""
+    m = re.match(r"%?(\S+)\s*=", nm)
+    return m.group(1) if m else nm
+
+
+def _read_xplane_sidecar(path: str) -> dict | None:
+    """xplane.pb -> {"ops": {name: _OpStats}, "peak_hbm_gbps",
+    "peak_tflops"} for the first device plane, or None when the file is
+    unreadable/malformed (the json-only path takes over)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        planes = [v for fn, wt, v in _fields(data) if fn == 1 and wt == 2]
+        for plane in planes:
+            pf = list(_fields(plane))
+            name = _first(pf, 2, b"").decode("utf-8", "replace")
+            if not name.startswith(DEVICE_PROCESS_PREFIX):
+                continue
+            return _parse_device_plane(pf)
+        return None
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _parse_device_plane(pf) -> dict:
+    stat_names: dict[int, str] = {}
+    for fn, wt, v in pf:
+        if fn == 5 and wt == 2:
+            ent = list(_fields(v))
+            val = _first(ent, 2)
+            if isinstance(val, bytes):
+                sm = list(_fields(val))
+                sid = _first(ent, 1, _first(sm, 1))
+                nm = _first(sm, 2, b"?")
+                stat_names[sid] = nm.decode("utf-8", "replace") \
+                    if isinstance(nm, bytes) else str(nm)
+    out: dict = {"ops": {}, "peak_hbm_gbps": None, "peak_tflops": None}
+    for fn, wt, v in pf:   # plane-level stats: the device's own peaks
+        if fn == 6 and wt == 2:
+            sf = list(_fields(v))
+            sn = stat_names.get(_first(sf, 1))
+            if sn == "peak_hbm_bw_gigabytes_per_second":
+                out["peak_hbm_gbps"] = _as_float(_stat_value(sf))
+            elif sn == "peak_teraflops_per_second":
+                out["peak_tflops"] = _as_float(_stat_value(sf))
+    for fn, wt, v in pf:   # per-op invariant stats live on the metadata
+        if fn != 4 or wt != 2:
+            continue
+        ent = list(_fields(v))
+        val = _first(ent, 2)
+        if not isinstance(val, bytes):
+            continue
+        em = list(_fields(val))
+        hbm = onchip = None
+        flops = None
+        for f, w, x in em:
+            if f != 5 or w != 2:
+                continue
+            sf = list(_fields(x))
+            sn = stat_names.get(_first(sf, 1))
+            if sn == "flops":
+                sv = _stat_value(sf)
+                if isinstance(sv, (int, float)):
+                    flops = int(sv)
+            elif sn == "memory_access_breakdown":
+                raw = _stat_value(sf)
+                if isinstance(raw, bytes):
+                    hbm = hbm or 0
+                    onchip = onchip or 0
+                    for bf, bw, bv in _fields(raw):
+                        if bf == 1 and bw == 2:
+                            mf = list(_fields(bv))
+                            space = _first(mf, 2, 0)
+                            nbytes = _first(mf, 3, 0) or 0
+                            if space == HBM_MEMORY_SPACE:
+                                hbm += nbytes
+                            else:
+                                onchip += nbytes
+        if hbm is None and flops is None:
+            continue
+        out["ops"][_short_name(em)] = _OpStats(
+            hbm_bytes=hbm, onchip_bytes=onchip, flops=flops)
+    return out
+
+
+def _as_float(v):
+    return float(v) if isinstance(v, (int, float)) else None

@@ -34,7 +34,10 @@ LANE_QUARANTINE = "lane-quarantine"    # PDHG lane guard reset lanes
 DISPATCH = "dispatch"                  # one coalesced megabatch dispatched
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
-PROFILE = "profile"                    # profiler session start/stop
+PROFILE = "profile"                    # profiler lifecycle: "start", or
+                                       # "captured" + trace_dir once a
+                                       # capture is VERIFIED on disk
+                                       # (analyze auto-discovery key)
 SPAN = "span"                          # one timed wheel phase (host wall)
 RUN_START = "run-start"
 RUN_END = "run-end"                    # exit reason + final gap

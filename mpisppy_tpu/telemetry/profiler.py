@@ -1,5 +1,6 @@
 ###############################################################################
-# Profiler hooks (ISSUE 3 tentpole, part 3; docs/telemetry.md).
+# Profiler hooks (ISSUE 3 tentpole, part 3; ISSUE 7 hardening;
+# docs/telemetry.md).
 #
 # Two layers:
 #   * annotate(name) / step(name, n) — thin wrappers over
@@ -13,10 +14,19 @@
 #   * ProfilerSession — the --profile-dir CLI flag: brackets N wheel
 #     iterations with jax.profiler.start_trace/stop_trace, skipping the
 #     compile-heavy first iterations so the trace shows steady state.
+#
+# Hardening contract (ISSUE 7): a missing or unwritable profile_dir —
+# a read-only pod filesystem, a typo'd path — degrades to a console
+# warning, never an unhandled exception; and the `profile` event that
+# advertises a capture (action "captured", carrying the capture dir
+# for `telemetry analyze` auto-discovery) is emitted ONLY after the
+# trace files are verified on disk, so a trace row never points at a
+# capture that silently failed to materialize.
 ###############################################################################
 from __future__ import annotations
 
 import contextlib
+import os
 
 
 def annotate(name: str):
@@ -31,7 +41,8 @@ def annotate(name: str):
 
 def step(name: str, step_num: int):
     """StepTraceAnnotation: marks one wheel iteration as a training-
-    style 'step' so trace viewers compute per-step statistics."""
+    style 'step' so trace viewers (and telemetry/deviceprof.py) compute
+    per-step device statistics keyed by hub_iter."""
     try:
         import jax.profiler
         return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
@@ -55,41 +66,82 @@ class ProfilerSession:
         self.start_iter = int(start_iter)
         self.active = False
         self.failed = False
+        self.done = False      # window completed: never re-arm
         self._bus = bus
         self._run = run
+        self._known_captures: set = set()
 
-    def _emit(self, action: str, hub_iter: int | None):
+    def _emit(self, action: str, hub_iter: int | None, **extra):
         if self._bus is not None:
             from mpisppy_tpu.telemetry import events as ev
             self._bus.emit(ev.PROFILE, run=self._run, cyl="hub",
                            hub_iter=hub_iter, action=action,
-                           profile_dir=self.profile_dir)
+                           profile_dir=self.profile_dir, **extra)
+
+    def _warn(self, msg: str) -> None:
+        from mpisppy_tpu.telemetry import console
+        console.log(f"WARNING: profiler: {msg}", cyl="hub")
+
+    def _capture_dirs(self) -> set:
+        try:
+            from mpisppy_tpu.telemetry import deviceprof
+            return {c["dir"]
+                    for c in deviceprof.discover_captures(
+                        self.profile_dir)}
+        except (OSError, ValueError):
+            return set()
+
+    def _fail(self, msg: str) -> None:
+        # a broken profiler backend / filesystem must never kill the
+        # run: warn once, then stand down for the rest of the wheel
+        self._warn(f"{msg} — device profiling disabled for this run")
+        self.failed = True
+        self.active = False
 
     def on_sync(self, hub_iter: int) -> None:
-        if self.failed:
+        if self.failed or self.done:
             return
-        try:
-            import jax.profiler
-            if not self.active and hub_iter >= self.start_iter:
-                jax.profiler.start_trace(self.profile_dir)
-                self.active = True
-                self._emit("start", hub_iter)
-            elif self.active \
-                    and hub_iter >= self.start_iter + self.num_iters:
-                jax.profiler.stop_trace()
-                self.active = False
-                self._emit("stop", hub_iter)
-        except Exception:
-            # a broken profiler backend must never kill the run
-            self.failed = True
-            self.active = False
-
-    def close(self) -> None:
-        if self.active:
+        if not self.active and hub_iter >= self.start_iter:
+            try:
+                os.makedirs(self.profile_dir, exist_ok=True)
+            except OSError as e:
+                return self._fail(
+                    f"cannot create --profile-dir "
+                    f"{self.profile_dir!r} ({e})")
+            if not os.access(self.profile_dir, os.W_OK):
+                return self._fail(f"--profile-dir {self.profile_dir!r} "
+                                  "is not writable")
+            self._known_captures = self._capture_dirs()
             try:
                 import jax.profiler
-                jax.profiler.stop_trace()
-                self._emit("stop", None)
-            except Exception:
-                pass
+                jax.profiler.start_trace(self.profile_dir)
+            except Exception as e:
+                return self._fail(f"start_trace failed ({e})")
+            self.active = True
+            self._emit("start", hub_iter)
+        elif self.active \
+                and hub_iter >= self.start_iter + self.num_iters:
+            self._stop(hub_iter)
+
+    def _stop(self, hub_iter: int | None) -> None:
+        self.done = True       # one window per session: never re-arm
+        try:
+            import jax.profiler
+            jax.profiler.stop_trace()
+        except Exception as e:
             self.active = False
+            return self._fail(f"stop_trace failed ({e})")
+        self.active = False
+        # the `profile` "captured" event is a claim that analyzable
+        # trace files EXIST — verify before advertising (ISSUE 7)
+        new = self._capture_dirs() - self._known_captures
+        if new:
+            self._emit("captured", hub_iter,
+                       trace_dir=sorted(new)[-1])
+        else:
+            self._warn(f"trace stopped but no capture landed under "
+                       f"{self.profile_dir!r} (backend wrote nothing)")
+
+    def close(self) -> None:
+        if self.active and not self.failed:
+            self._stop(None)

@@ -10,7 +10,10 @@
 #
 # Accepted artifact forms (auto-detected per file):
 #   * an analyzer report (telemetry/analyze.py --json output;
-#     schema mpisppy-tpu-analyze/1);
+#     schema mpisppy-tpu-analyze/1), including its `device` section;
+#   * a device roofline report (telemetry/roofline.py; schema
+#     mpisppy-tpu-deviceprof/1) — stream/achieved GB/s, overlap_frac,
+#     MFU and device_sec_per_iter gate direction-aware (ISSUE 7);
 #   * a BENCH_DETAIL.json-style dict (bench.py output: *_to_1pct_gap
 #     sections, wheel_overhead, measured_mfu, sweep_iters_per_sec,
 #     embedded metrics_snapshot / dispatch stats);
@@ -30,6 +33,7 @@ import json
 import re
 
 ANALYZE_SCHEMA_PREFIX = "mpisppy-tpu-analyze/"
+DEVPROF_SCHEMA_PREFIX = "mpisppy-tpu-deviceprof/"
 
 #: (key regex, direction, relative threshold).  direction "up" = larger
 #: is worse, "down" = smaller is worse.  First match wins; keys that
@@ -44,6 +48,17 @@ GATES: tuple[tuple[str, str, float], ...] = (
     (r"unexpected_recompiles", "up", 0.0),
     (r"guard_resets", "up", 0.0),
     (r"(^|\.)final_rel_gap$", "up", 0.25),
+    # device-trace roofline metrics (telemetry/roofline.py, ISSUE 7):
+    # bandwidth, DMA/compute overlap and MFU falling is a regression;
+    # device time per iteration rising is one.  These guard the
+    # ROADMAP item-2 wins (bf16x3, Pallas double-buffer) once landed.
+    (r"measured_stream_gbps", "down", 0.10),
+    (r"achieved_hbm_gbps", "down", 0.10),
+    (r"hbm_roofline_frac", "down", 0.10),
+    (r"overlap_frac", "down", 0.10),
+    (r"(^|\.)mfu$", "down", 0.10),
+    (r"device_sec_per_iter", "up", 0.10),
+    (r"dma\.exposed_s$", "up", 0.25),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -133,12 +148,34 @@ def _flatten(prefix: str, obj, out: dict) -> None:
         out[prefix] = float(obj)
 
 
+def _device_metrics(dev: dict, out: dict, prefix: str = "device"):
+    """Gate-relevant keys of a roofline report (telemetry/roofline.py),
+    shared by standalone device reports and analyzer rep['device']."""
+    for k in ("device_sec_per_iter", "measured_stream_gbps",
+              "achieved_hbm_gbps", "hbm_roofline_frac", "mfu",
+              "overlap_frac", "opaque_frac"):
+        if isinstance(dev.get(k), (int, float)) \
+                and not isinstance(dev.get(k), bool):
+            out[f"{prefix}.{k}"] = float(dev[k])
+    dma = dev.get("dma") or {}
+    for k in ("exposed_s", "inflight_s"):
+        if isinstance(dma.get(k), (int, float)):
+            out[f"{prefix}.dma.{k}"] = float(dma[k])
+    med = (dev.get("steps") or {}).get("sec_per_iter_median")
+    if isinstance(med, (int, float)):
+        out[f"{prefix}.steps.sec_per_iter_median"] = float(med)
+
+
 def extract_metrics(obj: dict) -> dict[str, float]:
     """Flatten an artifact into {dotted_key: number}.  Analyzer reports
     keep only the gate-relevant sections (timings, bounds, dispatch,
     guard totals) so two reports of different runs stay comparable."""
     out: dict[str, float] = {}
     schema = obj.get("schema", "") if isinstance(obj, dict) else ""
+    if isinstance(schema, str) and schema.startswith(
+            DEVPROF_SCHEMA_PREFIX):
+        _device_metrics(obj, out, prefix="device")
+        return out
     if isinstance(schema, str) and schema.startswith(
             ANALYZE_SCHEMA_PREFIX):
         _flatten("iteration", obj.get("iteration") or {}, out)
@@ -155,6 +192,8 @@ def extract_metrics(obj: dict) -> dict[str, float]:
                     and k.get("pdhg_guard_resets_total") is not None:
                 out[f"kernel.{cyl}.guard_resets"] = float(
                     k["pdhg_guard_resets_total"])
+        if isinstance(obj.get("device"), dict):
+            _device_metrics(obj["device"], out, prefix="device")
         out.pop("iteration.count", None)
         return out
     _flatten("", obj, out)

@@ -1,0 +1,231 @@
+###############################################################################
+# `telemetry watch` — live wheel monitoring (ISSUE 7 tentpole, part 4;
+# docs/telemetry.md).
+#
+# Tails a RUNNING wheel's --trace-jsonl stream (and, optionally, its
+# --metrics-snapshot Prometheus file) and renders a refreshing status
+# block: bound/gap, steady-state sec/iter, dispatch occupancy and
+# queue pressure, quarantine/strike counts, checkpoint age.  Built for
+# the long S=100k runs where the console scrolls too fast to read and
+# the analyzer only answers post-mortem.
+#
+# Pure stdlib, incremental: the file is read FROM THE LAST OFFSET each
+# tick (a 10-hour trace is parsed once, not per refresh), torn final
+# lines are retried next tick, and a log-rotated/truncated file is
+# detected by shrinkage and re-read from the top.  `--once` renders a
+# single snapshot and exits — the mode CI smoke-tests.
+###############################################################################
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+class WatchState:
+    """Rolling view over one run's event stream (newest run wins —
+    a restarted wheel appending to the same path takes over the
+    display, matching the analyzer's default run selection)."""
+
+    def __init__(self):
+        self.run = None
+        self.hub_class = None
+        self.events = 0
+        self.last_iter = None
+        self.outer = self.inner = self.rel_gap = None
+        self.iter_monos: list = []      # (iter, t_mono) tail
+        self.megabatch_lanes = 0
+        self.megabatch_padded = 0
+        self.megabatches = 0
+        self.dispatch_last: dict = {}
+        self.quarantine_resets = 0
+        self.strikes = 0
+        self.disables = 0
+        self.faults = 0
+        self.ckpt_writes = 0
+        self.last_ckpt_wall = None
+        self.last_event_wall = None
+        self.end: dict | None = None
+        self.profile_dir = None
+
+    def feed(self, row: dict) -> None:
+        kind = row.get("kind")
+        run = row.get("run")
+        if run and run != self.run:
+            if kind == "run-start" or self.run is None:
+                # new segment: reset to follow the newest run
+                self.__init__()
+                self.run = run
+            else:
+                return                 # stale cross-run stragglers
+        self.events += 1
+        self.last_event_wall = row.get("t_wall", self.last_event_wall)
+        data = row.get("data", {})
+        it = row.get("iter")
+        if kind == "run-start":
+            self.hub_class = data.get("hub_class")
+        elif kind == "hub-iteration":
+            self.last_iter = data.get("iter", it)
+            self.outer = data.get("outer", self.outer)
+            self.inner = data.get("inner", self.inner)
+            self.rel_gap = data.get("rel_gap", self.rel_gap)
+            if row.get("t_mono") is not None:
+                self.iter_monos.append((self.last_iter, row["t_mono"]))
+                del self.iter_monos[:-32]
+        elif kind == "dispatch":
+            if row.get("cyl") == "dispatch":
+                self.megabatches += 1
+                self.megabatch_lanes += data.get("lanes", 0)
+                self.megabatch_padded += data.get("padded_to", 0)
+            else:
+                self.dispatch_last = data
+        elif kind == "lane-quarantine":
+            self.quarantine_resets += data.get("resets", 0)
+        elif kind == "spoke-strike":
+            self.strikes += 1
+        elif kind == "spoke-disable":
+            self.disables += 1
+        elif kind == "fault-injected":
+            self.faults += 1
+        elif kind == "checkpoint-write":
+            self.ckpt_writes += 1
+            self.last_ckpt_wall = row.get("t_wall")
+        elif kind == "run-end":
+            self.end = data
+        elif kind == "profile":
+            self.profile_dir = data.get("profile_dir", self.profile_dir)
+
+    @property
+    def sec_per_iter(self) -> float | None:
+        ms = [m for _, m in self.iter_monos]
+        deltas = sorted(b - a for a, b in zip(ms, ms[1:]) if b > a)
+        return deltas[len(deltas) // 2] if deltas else None
+
+
+def _follow(path: str, state: WatchState, pos: int) -> int:
+    """Feed appended complete lines; returns the new offset."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return pos
+    if size < pos:      # truncated/rotated: start over
+        state.__init__()
+        pos = 0
+    if size == pos:
+        return pos
+    with open(path, "rb") as f:
+        f.seek(pos)
+        chunk = f.read()
+    # keep a torn final line for the next tick
+    last_nl = chunk.rfind(b"\n")
+    if last_nl < 0:
+        return pos
+    for line in chunk[:last_nl].split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            state.feed(json.loads(line))
+        except ValueError:
+            continue
+    return pos + last_nl + 1
+
+
+def read_metrics_snapshot(path: str) -> dict[str, float]:
+    """Prometheus text exposition -> {metric_name: value} (labels are
+    folded into the name verbatim; last sample wins)."""
+    out: dict[str, float] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.rsplit(" ", 1)
+                if len(parts) != 2:
+                    continue
+                try:
+                    out[parts[0]] = float(parts[1])
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _fmt(v, spec=".6g"):
+    return "-" if v is None else format(v, spec)
+
+
+def render_status(state: WatchState,
+                  metrics: dict[str, float] | None = None) -> str:
+    L: list[str] = []
+    age = (time.time() - state.last_event_wall
+           if state.last_event_wall else None)
+    L.append(f"run {state.run or '?'}  hub={state.hub_class or '?'}  "
+             f"events {state.events}"
+             + (f"  last event {age:.1f}s ago" if age is not None
+                else ""))
+    gap = state.rel_gap
+    L.append(f"iter {_fmt(state.last_iter)}  outer {_fmt(state.outer)}  "
+             f"inner {_fmt(state.inner)}  rel_gap {_fmt(gap, '.3e')}"
+             f"  sec/iter {_fmt(state.sec_per_iter, '.4g')}")
+    occ = (state.megabatch_lanes / state.megabatch_padded
+           if state.megabatch_padded else None)
+    d = state.dispatch_last
+    L.append(f"dispatch: megabatches {state.megabatches}"
+             f"  occupancy {_fmt(occ, '.3f')}"
+             f"  inflight_max {_fmt(d.get('inflight_max'))}"
+             f"  compiles {_fmt(d.get('backend_compiles'))}"
+             f"  unexpected {_fmt(d.get('unexpected_recompiles'))}")
+    ck_age = (time.time() - state.last_ckpt_wall
+              if state.last_ckpt_wall else None)
+    L.append(f"resilience: quarantine resets {state.quarantine_resets}"
+             f"  strikes {state.strikes}  disabled {state.disables}"
+             f"  faults {state.faults}"
+             f"  ckpt writes {state.ckpt_writes}"
+             + (f" (last {ck_age:.0f}s ago)" if ck_age is not None
+                else ""))
+    if metrics:
+        keys = sorted(k for k in metrics
+                      if k.startswith(("dispatch_", "wheel_", "pdhg_")))
+        if keys:
+            L.append("metrics: " + "  ".join(
+                f"{k}={metrics[k]:g}" for k in keys[:6]))
+    if state.end is not None:
+        L.append(f"RUN ENDED: {state.end.get('reason')}  rel_gap "
+                 f"{_fmt(state.end.get('rel_gap'), '.3e')}")
+    if state.profile_dir:
+        L.append(f"profiler captures under {state.profile_dir} "
+                 f"(analyze --profile-dir to inspect)")
+    return "\n".join(L)
+
+
+def watch(trace_path: str, metrics_path: str | None = None,
+          interval: float = 2.0, once: bool = False,
+          out=None) -> int:
+    """The `telemetry watch` loop.  Returns the process exit code."""
+    out = out or sys.stdout
+    if not os.path.exists(trace_path):
+        print(f"watch: no trace at {trace_path!r}", file=sys.stderr)
+        return 1
+    state = WatchState()
+    pos = 0
+    try:
+        while True:
+            pos = _follow(trace_path, state, pos)
+            metrics = (read_metrics_snapshot(metrics_path)
+                       if metrics_path else None)
+            block = render_status(state, metrics)
+            if once:
+                print(block, file=out, flush=True)
+                return 0
+            # clear + repaint (plain ANSI home; scrollback stays sane
+            # on dumb terminals because the block is short)
+            print("\x1b[2J\x1b[H" + block, file=out, flush=True)
+            if state.end is not None:
+                return 0
+            time.sleep(max(0.2, interval))
+    except KeyboardInterrupt:
+        return 0

@@ -8,7 +8,8 @@
 # Every performance number quoted in README's measured-results section
 # (the block opening with "Measured on" and closing at "Out of scope")
 # must trace to a committed benchmark artifact: a numeric field of
-# BENCH_DETAIL.json or any BENCH_r0N.json (including numbers inside a
+# BENCH_DETAIL.json, DEVICE_PROFILE.json (trace-derived device
+# profiles, ISSUE 7) or any BENCH_r0N.json (including numbers inside a
 # wrapper's possibly-truncated stdout `tail`).  "Performance number"
 # means a number carrying a perf unit — seconds, x-factors, percents,
 # iterations, iters/s, TFLOPs, GB/s; config numbers ("900 scenarios",
@@ -81,9 +82,15 @@ def _collect_numbers(obj, pool: set) -> None:
 def artifact_pool(repo: str = REPO) -> set:
     pool: set = set()
     paths = sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json")))
-    detail = os.path.join(repo, "BENCH_DETAIL.json")
-    if os.path.exists(detail):
-        paths.append(detail)
+    for extra in ("BENCH_DETAIL.json",
+                  # trace-derived device profiles (ISSUE 7): README
+                  # GB/s / MFU / overlap claims must trace to committed
+                  # device_profile artifact fields, same
+                  # display-precision matching as every other number
+                  "DEVICE_PROFILE.json"):
+        p = os.path.join(repo, extra)
+        if os.path.exists(p):
+            paths.append(p)
     for p in paths:
         try:
             with open(p) as f:
@@ -142,7 +149,8 @@ def find_violations(readme: str = README,
             violations.append(
                 f"{os.path.basename(readme)}: perf claim {display!r} "
                 f"has no witness in BENCH_DETAIL.json / BENCH_r0*.json "
-                f"— quote the committed artifact, not a local run")
+                f"/ DEVICE_PROFILE.json — quote the committed "
+                f"artifact, not a local run")
     return violations
 
 

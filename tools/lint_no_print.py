@@ -27,6 +27,8 @@ ALLOWED_FILES = {
     "telemetry/sinks.py",     # ConsoleSink rendering
     "telemetry/__main__.py",  # trace-toolbox CLI (its stdout IS the
                               # product: reports + JSON)
+    "telemetry/watch.py",     # live-monitor renderer (stdout IS the
+                              # product: the refreshing status block)
     "__main__.py",            # CLI entry point
     "parallel/_multihost_dryrun.py",  # multihost smoke entry point
     "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
