@@ -39,15 +39,43 @@ Array = jax.Array
 # building jitted programs via set_matvec_precision().
 MATVEC_PRECISION = jax.lax.Precision.HIGHEST
 
+#: The precision-alias registry — the ONE table every knob resolves
+#: through (module default, PDHGOptions.iter_precision, the Pallas
+#: kernel, the --iter-precision CLI flag).  Pass-count names (bf16x3 /
+#: bf16x6) are the preferred spelling in configs and artifacts; the
+#: jax.lax.Precision names remain accepted for back-compat.
+PRECISION_ALIASES = {
+    "bf16": jax.lax.Precision.DEFAULT,
+    "default": jax.lax.Precision.DEFAULT,
+    "bf16x3": jax.lax.Precision.HIGH,    # 3-pass: halves HBM+MXU work,
+    "high": jax.lax.Precision.HIGH,      #   ~4e-6 rel error per matvec
+    "bf16x6": jax.lax.Precision.HIGHEST,  # 6-pass: full f32 accuracy
+    "highest": jax.lax.Precision.HIGHEST,
+    "f32": jax.lax.Precision.HIGHEST,
+}
+
 
 def as_precision(p):
-    """'high' / 'highest' / jax.lax.Precision / None -> Precision|None.
-    The single parser for every precision knob (module default, PDHG
-    iter_precision, the Pallas kernel) so aliases/validation live in
-    one place."""
+    """Alias / jax.lax.Precision / None -> Precision|None.
+
+    The single parser for every precision knob so aliases/validation
+    live in one place.  Unknown strings raise with the full alias list
+    — a typo'd --iter-precision must fail at config time, not silently
+    trace at the module default."""
     if p is None or isinstance(p, jax.lax.Precision):
         return p
-    return getattr(jax.lax.Precision, p.upper())
+    if not isinstance(p, str):
+        raise TypeError(
+            f"precision must be None, a jax.lax.Precision, or one of "
+            f"{sorted(PRECISION_ALIASES)}; got {p!r}")
+    try:
+        return PRECISION_ALIASES[p.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision alias {p!r}; valid aliases: "
+            f"{', '.join(sorted(PRECISION_ALIASES))} "
+            f"(bf16x3 = 3-pass bf16 iteration matvecs, ~4e-6 relative "
+            f"error per matvec; bf16x6 = full-f32 6-pass)") from None
 
 
 def set_matvec_precision(p) -> None:

@@ -88,13 +88,23 @@ class PDHGOptions:
     # lift MXU utilization (bigger GEMM M dim, fewer grid steps) until
     # the tile's solver state outgrows VMEM
     pallas_tile_s: int = 128
+    # Double-buffer the Pallas window kernel's scenario tiles: the next
+    # tile's solver state is async-copied HBM->VMEM while the current
+    # tile runs its restart window, and finished tiles write back
+    # asynchronously — the S=100k fix for tile DMA serialized with
+    # compute (ops/pdhg_pallas.py; measured 485 of 819 GB/s before).
+    # False keeps the single-buffer grid kernel (same math bit-for-bit,
+    # tests/test_pdhg_pallas.py).
+    pallas_pipeline: bool = True
     # MXU precision for the ITERATION matvecs only (restart candidate
     # scoring and convergence tests always run at the boxqp module
-    # default, HIGHEST = 6-pass bf16, so a cheaper iteration precision
-    # can never mis-certify a solution).  None = module default;
-    # "high" = 3-pass bf16, ~2x MXU throughput, measured on-chip to
+    # default, HIGHEST = bf16x6, so a cheaper iteration precision can
+    # never mis-certify a solution).  None = module default; "bf16x3"
+    # (alias "high") = 3-pass bf16 — half the HBM bytes and MXU passes
+    # per matvec, ~4e-6 relative error per matvec, measured on-chip to
     # reach ~1e-6 relative KKT on sslp-family LPs when scoring stays
-    # exact.  See ops/boxqp.py MATVEC_PRECISION.
+    # exact.  Aliases resolve through ops/boxqp.py PRECISION_ALIASES;
+    # unknown strings raise at trace time with the valid list.
     iter_precision: str | None = None
     # Per-lane divergence guard (resilience subsystem, docs/resilience.md):
     # at each restart boundary, lanes whose iterates are non-finite or
@@ -417,7 +427,8 @@ def _window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
         x, y, xs, ys = pdhg_pallas.run_window(
             p, st.x, st.y, st.x_sum, st.y_sum, tau, sigma, st.done,
             opts.restart_period, tile_s=opts.pallas_tile_s,
-            precision=opts.iter_precision, interpret=interp)
+            precision=opts.iter_precision,
+            pipeline=opts.pallas_pipeline, interpret=interp)
         st = dataclasses.replace(st, x=x, y=y, x_sum=xs, y_sum=ys)
     else:
         prec = _iter_precision(opts)

@@ -18,8 +18,21 @@
 # Scope: dense SHARED-A batches (the sslp/uc/netdes shape: deterministic
 # constraint matrix, scenario-varying c/q/rhs).  ELL and per-scenario-A
 # batches keep the XLA path (ops/pdhg.py _window falls back
-# automatically).  Matmuls run at HIGHEST precision: default bf16 MXU
-# passes stall PDHG at ~1e-2 KKT residual on-chip (measured round 1).
+# automatically).  Matmuls run at HIGHEST precision by default: single-
+# pass bf16 MXU passes stall PDHG at ~1e-2 KKT residual on-chip
+# (measured round 1); the opt-in bf16x3 iteration mode
+# (PDHGOptions.iter_precision="bf16x3") halves bytes and passes while
+# restart scoring stays exact.
+#
+# Two tile engines share one iteration trace (_tile_math):
+#   * single-buffer grid kernel (pipeline=False): one tile per grid
+#     step, operands staged by the BlockSpec pipeline;
+#   * double-buffered pipeline (pipeline=True, default): one invocation
+#     loops over tiles with manual async copies and two VMEM slots per
+#     per-scenario operand, so the next tile's HBM->VMEM stream (and
+#     the previous tile's write-back) overlaps the current tile's
+#     compute — the fix for the S=100k profile where tile DMA was
+#     serialized with compute (485 of 819 GB/s streamed).
 #
 # There is no reference analog to cite: mpi-sppy delegates subproblem
 # solves to Gurobi (ref:mpisppy/spopt.py:884); this kernel is part of
@@ -100,8 +113,15 @@ def _dot3(v_split, M_hi, M_lo):
     return acc
 
 
-def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
-    """All n_iters PDHG iterations for one scenario tile, VMEM-resident.
+def _tile_math(n_iters: int, precision, has_cones: bool,
+               tau0, sigma0, done, c, q, l, u, bl, bu,  # noqa: E741
+               mats, cone_vals, x0, y0, xs0, ys0):
+    """All n_iters PDHG iterations for one scenario tile, on VALUES.
+
+    Shared by the single-buffer grid kernel (_window_kernel) and the
+    double-buffered pipeline kernel (see run_window) — both engines run
+    this exact trace on their VMEM-resident tile, so their outputs are
+    bit-identical by construction (tests/test_pdhg_pallas.py).
 
     Math matches the XLA path (ops/pdhg.py _pdhg_iter) up to float
     reassociation (loop invariants are hoisted here, see below):
@@ -118,33 +138,14 @@ def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
     (T, m) x (m, C) dot IS a segment sum with static shapes.
     """
     three_pass = precision == jax.lax.Precision.HIGH
-    # matrix refs are present only for the precision mode in use (2 for
-    # a single-dot mode, 4 for the bf16x3 split) — dead operands would
-    # cost a DMA + VMEM residency per grid step
-    nmat = 4 if three_pass else 2
-    (tau_ref, sigma_ref, done_ref,
-     c_ref, q_ref, l_ref, u_ref, bl_ref, bu_ref) = refs[:9]
-    mat_refs = refs[9:9 + nmat]
-    k = 9 + nmat
-    cone_refs = refs[k:k + 7] if has_cones else ()
-    k += 7 if has_cones else 0
-    (x0_ref, y0_ref, xs0_ref, ys0_ref,
-     x_ref, y_ref, xs_ref, ys_ref) = refs[k:]
-
-    live = 1.0 - done_ref[:]  # (T, 1) 1.0 = still running
+    live = 1.0 - done         # (T, 1) 1.0 = still running
     # Done-masking folds into the step sizes: with tau = sigma = 0 the
     # iteration is an exact no-op (x1 = clip(x, l, u) = x since every
     # iterate is box-feasible; y1 = w - clip(w, 0, 0) = y), so frozen
     # scenarios need no blend passes — they still accumulate their
     # frozen iterate into the window sums, matching the XLA path.
-    tau = tau_ref[:] * live   # (T, 1)
-    sigma = sigma_ref[:] * live
-    c = c_ref[:]
-    q = q_ref[:]
-    l = l_ref[:]              # noqa: E741  (T|1, n)
-    u = u_ref[:]
-    bl = bl_ref[:]
-    bu = bu_ref[:]
+    tau = tau0 * live         # (T, 1)
+    sigma = sigma0 * live
     # Loop-invariant precomputes: the VPU, not the MXU, bounds this
     # kernel at bench shapes (measured: 6->3 MXU passes bought only
     # ~15%), and per-element divides are its costliest ops.  Hoisting
@@ -157,10 +158,7 @@ def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
     # mv(v) = A v (BoxQP.matvec) — contracting with the (m, n) block
     # computes A'y, with the (n, m) block computes A v.
     if three_pass:
-        A_hi = mat_refs[0][:]     # (m, n) bf16
-        AT_hi = mat_refs[1][:]    # (n, m) bf16
-        A_lo = mat_refs[2][:]
-        AT_lo = mat_refs[3][:]
+        A_hi, AT_hi, A_lo, AT_lo = mats   # (m, n)/(n, m) bf16 splits
 
         def rmv(v, _A=A_hi, _Al=A_lo):
             return _dot3(_split_bf16_kernel(v), _A, _Al)
@@ -169,8 +167,7 @@ def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
             return _dot3(_split_bf16_kernel(v), _AT, _ATl)
     else:
         hp = precision if precision is not None else jax.lax.Precision.HIGHEST
-        A = mat_refs[0][:]        # (m, n)
-        AT = mat_refs[1][:]       # (n, m)
+        A, AT = mats              # (m, n), (n, m)
 
         def rmv(v, _A=A):
             return jax.lax.dot_general(
@@ -183,16 +180,8 @@ def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
                 precision=hp, preferred_element_type=jnp.float32)
 
     if has_cones:
-        (shift_ref, soc_ref, head_ref,
-         mh_ref, mht_ref, mt_ref, mtt_ref) = cone_refs
-        shift = shift_ref[:]          # (T|1, m)
-        socm = soc_ref[:]             # (1, m) f32 masks
-        headm = head_ref[:]
+        (shift, socm, headm, Mhead, MheadT, Mtail, MtailT) = cone_vals
         tailm = socm - headm
-        Mhead = mh_ref[:]             # (C, m)
-        MheadT = mht_ref[:]           # (m, C)
-        Mtail = mt_ref[:]
-        MtailT = mtt_ref[:]
         dims = (((1,), (0,)), ((), ()))
 
         def xdot(a, b):
@@ -232,9 +221,34 @@ def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
             y1 = jnp.where(socm > 0.0, soc_prox(w, y), y1)
         return x1, y1, xs + x1, ys + y1
 
-    x, y, xs, ys = jax.lax.fori_loop(
-        0, n_iters, body,
-        (x0_ref[:], y0_ref[:], xs0_ref[:], ys0_ref[:]))
+    return jax.lax.fori_loop(0, n_iters, body, (x0, y0, xs0, ys0))
+
+
+def _window_kernel(n_iters: int, precision, has_cones: bool, *refs):
+    """Single-buffer grid kernel: one scenario tile per grid step, tile
+    operands staged into VMEM by the BlockSpec pipeline, iteration math
+    in _tile_math."""
+    three_pass = precision == jax.lax.Precision.HIGH
+    # matrix refs are present only for the precision mode in use (2 for
+    # a single-dot mode, 4 for the bf16x3 split) — dead operands would
+    # cost a DMA + VMEM residency per grid step
+    nmat = 4 if three_pass else 2
+    (tau_ref, sigma_ref, done_ref,
+     c_ref, q_ref, l_ref, u_ref, bl_ref, bu_ref) = refs[:9]
+    mat_refs = refs[9:9 + nmat]
+    k = 9 + nmat
+    cone_refs = refs[k:k + 7] if has_cones else ()
+    k += 7 if has_cones else 0
+    (x0_ref, y0_ref, xs0_ref, ys0_ref,
+     x_ref, y_ref, xs_ref, ys_ref) = refs[k:]
+
+    x, y, xs, ys = _tile_math(
+        n_iters, precision, has_cones,
+        tau_ref[:], sigma_ref[:], done_ref[:],
+        c_ref[:], q_ref[:], l_ref[:], u_ref[:], bl_ref[:], bu_ref[:],
+        tuple(r[:] for r in mat_refs),
+        tuple(r[:] for r in cone_refs),
+        x0_ref[:], y0_ref[:], xs0_ref[:], ys0_ref[:])
     x_ref[:] = x
     y_ref[:] = y
     xs_ref[:] = xs
@@ -268,11 +282,13 @@ def supported(p) -> bool:
 
 
 @partial(jax.jit,
-         static_argnames=("n_iters", "tile_s", "precision", "interpret"))
+         static_argnames=("n_iters", "tile_s", "precision", "pipeline",
+                          "interpret"))
 def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
                tau: Array, sigma: Array, done: Array,
                n_iters: int, tile_s: int = 128,
-               precision: str | None = None, interpret: bool = False):
+               precision: str | None = None, pipeline: bool = True,
+               interpret: bool = False):
     """n_iters PDHG iterations over the whole scenario batch via the
     tiled Pallas kernel.  Returns (x, y, x_sum, y_sum).
 
@@ -282,6 +298,16 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
     hardware tiles; pad columns get l=u=0 (iterates pinned at 0), pad
     rows get free bounds (dual pinned at 0), pad scenarios are marked
     done — all three are exact no-ops on the real problem.
+
+    `pipeline=True` (default) runs the DOUBLE-BUFFERED engine: one
+    kernel invocation loops over scenario tiles, async-copying the next
+    tile's solver state HBM->VMEM (and the previous tile's results
+    VMEM->HBM) while the current tile runs its restart window — the
+    S=100k fix for tile DMA serialized with compute (measured 485 of
+    819 GB/s streamed before; ROADMAP item 2).  The shared dense A
+    stays VMEM-resident either way.  False keeps the single-buffer
+    grid kernel; both engines run the same _tile_math trace per tile,
+    so their outputs are bit-identical (tests/test_pdhg_pallas.py).
     """
     S, n = x.shape
     m = y.shape[-1]
@@ -361,6 +387,13 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
         cone_ops = (shift_p, socm, headm) \
             + _membership_padded(spec, m, m_p, dt)
 
+    if pipeline:
+        xo, yo, xso, yso = _run_window_pipelined(
+            n_iters, prec, has_cones, tile_s, S_p, n_p, m_p, dt,
+            mats, (tau_p, sigma_p, done_p, c, q, l, u, bl, bu),
+            cone_ops, (x_p, y_p, xs_p, ys_p), interpret)
+        return (xo[:S, :n], yo[:S, :m], xso[:S, :n], yso[:S, :m])
+
     grid = (S_p // tile_s,)
 
     def vspec(arr, width):
@@ -414,3 +447,156 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
       x_p, y_p, xs_p, ys_p)
 
     return (xo[:S, :n], yo[:S, :m], xso[:S, :n], yso[:S, :m])
+
+
+def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
+                          dt, mats, params, cone_ops, state, interpret):
+    """The double-buffered window engine (ROADMAP item 2 / ISSUE 8).
+
+    One kernel invocation owns the whole scenario batch: per-scenario
+    operands stay in HBM (memory_space=ANY) and a manual async-copy
+    pipeline with TWO VMEM slots per operand prefetches tile t+1 while
+    tile t computes, and drains tile t's results back to HBM while tile
+    t+1 computes — the "prefetch-next-while-computing" discipline of
+    the TPU distributed-linear-algebra line (PAPERS.md: arXiv
+    2112.09017) applied to the solver-state stream that the profiler
+    showed serialized with compute at S=100k (485 of 819 GB/s;
+    telemetry/roofline.py overlap_frac / dma.exposed_s are the
+    acceptance instruments).
+
+    The shared dense A (and its bf16 hi/lo split under bf16x3), shared
+    bound rows, and the SOC membership matrices remain VMEM-resident
+    for the whole invocation, exactly like the single-buffer grid
+    kernel.  Write-back slot reuse is fenced: before tile t overwrites
+    output slot t%2, it waits on the DMA it issued for tile t-2 from
+    that slot; the final two write-backs drain after the tile loop.
+
+    Layout bookkeeping is static python: `dma_names` fixes the operand
+    order (always-batched tau/sigma/done + solver state, plus whichever
+    of c/q/l/u/bl/bu[/shift] are per-scenario); shared operands bypass
+    the pipeline entirely.
+    """
+    n_tiles = S_p // tile_s
+    tau_p, sigma_p, done_p, c, q, l, u, bl, bu = params  # noqa: E741
+    named = [("tau", tau_p, 1), ("sigma", sigma_p, 1), ("done", done_p, 1),
+             ("c", c, n_p), ("q", q, n_p), ("l", l, n_p), ("u", u, n_p),
+             ("bl", bl, m_p), ("bu", bu, m_p)]
+    cone_shared = ()
+    if has_cones:
+        named.append(("shift", cone_ops[0], m_p))
+        cone_shared = tuple(cone_ops[1:])
+    x_p, y_p, xs_p, ys_p = state
+    named += [("x", x_p, n_p), ("y", y_p, m_p),
+              ("xs", xs_p, n_p), ("ys", ys_p, m_p)]
+
+    dma_names, dma_arrs, dma_widths = [], [], []
+    shared_names, shared_arrs = [], []
+    for nm, arr, w in named:
+        if arr.shape[0] == 1:      # shared across the batch: no DMA
+            shared_names.append(nm)
+            shared_arrs.append(arr)
+        else:
+            dma_names.append(nm)
+            dma_arrs.append(arr)
+            dma_widths.append(w)
+    n_in = len(dma_arrs)
+    out_widths = (n_p, m_p, n_p, m_p)   # x, y, xs, ys
+
+    def kernel(*refs):
+        k = len(mats)
+        mat_vals = tuple(r[:] for r in refs[:k])
+        shared_vals = {nm: r[:] for nm, r
+                       in zip(shared_names, refs[k:k + len(shared_arrs)])}
+        k += len(shared_arrs)
+        cone_shared_vals = tuple(r[:] for r
+                                 in refs[k:k + len(cone_shared)])
+        k += len(cone_shared)
+        in_refs = refs[k:k + n_in]
+        k += n_in
+        out_refs = refs[k:k + 4]
+        k += 4
+        scr_in = refs[k:k + n_in]
+        k += n_in
+        scr_out = refs[k:k + 4]
+        k += 4
+        insem, outsem = refs[k], refs[k + 1]
+
+        def in_dma(j, slot, t):
+            return pltpu.make_async_copy(
+                in_refs[j].at[pl.ds(t * tile_s, tile_s)],
+                scr_in[j].at[slot],
+                insem.at[slot, j])
+
+        def out_dma(j, slot, t):
+            return pltpu.make_async_copy(
+                scr_out[j].at[slot],
+                out_refs[j].at[pl.ds(t * tile_s, tile_s)],
+                outsem.at[slot, j])
+
+        # warm-up: tile 0's state starts streaming before any compute
+        for j in range(n_in):
+            in_dma(j, 0, 0).start()
+
+        def tile_body(t, carry):
+            cur = jax.lax.rem(t, 2)
+            nxt = jax.lax.rem(t + 1, 2)
+
+            # prefetch tile t+1 into the other slot while t computes.
+            # Its previous occupant (tile t-1's inputs) was fully
+            # consumed during iteration t-1 — loads happen before this
+            # point in program order — so the slot is free.
+            @pl.when(t + 1 < n_tiles)
+            def _():
+                for j in range(n_in):
+                    in_dma(j, nxt, t + 1).start()
+
+            for j in range(n_in):
+                in_dma(j, cur, t).wait()
+
+            v = dict(shared_vals)
+            for nm, scr in zip(dma_names, scr_in):
+                v[nm] = scr[cur]
+            cone_vals = ()
+            if has_cones:
+                cone_vals = (v["shift"],) + cone_shared_vals
+            x1, y1, xs1, ys1 = _tile_math(
+                n_iters, prec, has_cones,
+                v["tau"], v["sigma"], v["done"],
+                v["c"], v["q"], v["l"], v["u"], v["bl"], v["bu"],
+                mat_vals, cone_vals, v["x"], v["y"], v["xs"], v["ys"])
+
+            # fence: the write-back issued from this slot two tiles ago
+            # must land before the slot is overwritten
+            @pl.when(t >= 2)
+            def _():
+                for j in range(4):
+                    out_dma(j, cur, t - 2).wait()
+            for j, val in enumerate((x1, y1, xs1, ys1)):
+                scr_out[j][cur] = val
+            for j in range(4):
+                out_dma(j, cur, t).start()
+            return carry
+
+        jax.lax.fori_loop(0, n_tiles, tile_body, 0)
+        # drain the last (up to) two in-flight write-backs
+        if n_tiles >= 2:
+            for j in range(4):
+                out_dma(j, (n_tiles - 2) % 2, n_tiles - 2).wait()
+        for j in range(4):
+            out_dma(j, (n_tiles - 1) % 2, n_tiles - 1).wait()
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+    n_resident = len(mats) + len(shared_arrs) + len(cone_shared)
+    return pl.pallas_call(
+        kernel,
+        in_specs=[vmem] * n_resident + [hbm] * n_in,
+        out_specs=[hbm] * 4,
+        out_shape=[jax.ShapeDtypeStruct((S_p, w), dt) for w in out_widths],
+        scratch_shapes=(
+            [pltpu.VMEM((2, tile_s, w), dt) for w in dma_widths]
+            + [pltpu.VMEM((2, tile_s, w), dt) for w in out_widths]
+            + [pltpu.SemaphoreType.DMA((2, n_in)),
+               pltpu.SemaphoreType.DMA((2, 4))]),
+        interpret=interpret,
+    )(*mats, *shared_arrs, *cone_shared, *dma_arrs)

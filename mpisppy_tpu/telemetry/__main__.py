@@ -20,8 +20,10 @@
 #       diff the perf metrics of two artifacts (analyzer --json
 #       reports, device roofline reports, BENCH_DETAIL.json, or
 #       BENCH_r0N.json wrappers).
-#   gate OLD NEW [--threshold KEY=FRAC ...] [--json]
-#       compare + direction-aware thresholds; exit 2 on a regression.
+#   gate OLD NEW [--threshold KEY=FRAC ...] [--milestones] [--json]
+#       compare + direction-aware thresholds + absolute milestone
+#       floors (ratchet by default, strict with --milestones); exit 2
+#       on a regression.
 ###############################################################################
 from __future__ import annotations
 
@@ -74,6 +76,13 @@ def _build_parser() -> argparse.ArgumentParser:
                             metavar="KEY=FRAC",
                             help="override: metric-key substring = "
                                  "relative threshold (repeatable)")
+            pc.add_argument("--milestones", action="store_true",
+                            help="make the absolute MILESTONE bounds "
+                                 "(S=10k sec_per_iter <= 0.045, S=100k "
+                                 "iters/s >= 2) bind even when the old "
+                                 "artifact predates the win; default "
+                                 "is ratchet semantics (bind once "
+                                 "landed)")
     return p
 
 
@@ -120,7 +129,9 @@ def main(argv=None) -> int:
             return 1
     try:
         if args.cmd == "gate":
-            rep = regress.gate_paths(args.old, args.new, overrides)
+            rep = regress.gate_paths(
+                args.old, args.new, overrides,
+                milestones=getattr(args, "milestones", False))
         else:
             rep = regress.compare_paths(args.old, args.new)
     except (OSError, ValueError) as e:

@@ -50,8 +50,9 @@ GATES: tuple[tuple[str, str, float], ...] = (
     (r"(^|\.)final_rel_gap$", "up", 0.25),
     # device-trace roofline metrics (telemetry/roofline.py, ISSUE 7):
     # bandwidth, DMA/compute overlap and MFU falling is a regression;
-    # device time per iteration rising is one.  These guard the
-    # ROADMAP item-2 wins (bf16x3, Pallas double-buffer) once landed.
+    # device time per iteration rising is one.  Together with the
+    # MILESTONES below these guard the ROADMAP item-2 / ISSUE-8 wins
+    # (bf16x3 iteration precision, Pallas double-buffer).
     (r"measured_stream_gbps", "down", 0.10),
     (r"achieved_hbm_gbps", "down", 0.10),
     (r"hbm_roofline_frac", "down", 0.10),
@@ -65,6 +66,26 @@ GATES: tuple[tuple[str, str, float], ...] = (
 #: counters (compiles, guard resets) tolerate tiny absolute wiggle
 ABS_SLACK = {"backend_compiles": 2.0, "guard_resets": 2.0,
              "unexpected_recompiles": 0.0}
+
+#: Absolute MILESTONE bounds (ISSUE 8 acceptance / ROADMAP item 2):
+#: (key regex, direction, bound).  direction "up": the value must stay
+#: <= bound; "down": >= bound.  Unlike the relative GATES these are
+#: floors/ceilings on the NEW artifact, with RATCHET semantics: a
+#: milestone only BINDS once the old artifact already meets it (the
+#: win has landed on hardware) — before that it is reported "pending",
+#: so pre-win fixture pairs keep gating green while a landed win can
+#: never silently regress past its acceptance line.  `gate(...,
+#: milestones=True)` / CLI --milestones forces every milestone to bind
+#: regardless (the strict mode CI runs on post-win artifacts).
+MILESTONES: tuple[tuple[str, str, float], ...] = (
+    # bf16x3 on the S=10k PH iteration: 0.0601 s/iter measured at full
+    # precision (BENCH_r05 / BENCH_DETAIL measured_mfu.S10000)
+    (r"measured_mfu\.S10000\.sec_per_iter$", "up", 0.045),
+    # double-buffered Pallas window at S=100k: 1.46 iters/s measured
+    # with the single-buffer kernel (sweep entries key by scenario
+    # count — extract_metrics rewrites list indices to S<count>)
+    (r"sweep_iters_per_sec\.S100000\.iters_per_sec$", "down", 2.0),
+)
 
 
 # ---------------------------------------------------------------------------
@@ -124,9 +145,15 @@ def _salvage_tail(tail: str) -> dict:
 
 def load_artifact(path: str) -> dict:
     """Load + normalize one artifact file into a bench-style dict (or
-    an analyzer report, passed through)."""
+    an analyzer report, passed through).  Driver wrappers carry the
+    bench stdout in `tail` (salvaged); assembled wrappers (e.g. the
+    committed BENCH_r06.json, built from prior on-TPU captures in a
+    round whose container had no chip) carry the sections directly in
+    `parsed`."""
     with open(path) as f:
         obj = json.load(f)
+    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+        return obj["parsed"]
     if isinstance(obj, dict) and isinstance(obj.get("tail"), str) \
             and "cmd" in obj:
         return _salvage_tail(obj["tail"])
@@ -138,10 +165,18 @@ def _flatten(prefix: str, obj, out: dict) -> None:
         for k, v in obj.items():
             _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
     elif isinstance(obj, list):
-        # positional keys: bench lists (the scenario sweep) keep a
-        # stable order across rounds
+        # bench lists key by scenario count when the entries carry one
+        # (the sweep) — "sweep_iters_per_sec.S100000.iters_per_sec"
+        # stays comparable across rounds even when the sweep grid
+        # changes, and is what MILESTONES anchors on; other lists keep
+        # stable positional keys
         for i, v in enumerate(obj):
-            _flatten(f"{prefix}.{i}", v, out)
+            key = i
+            if isinstance(v, dict) \
+                    and isinstance(v.get("scenarios"), (int, float)) \
+                    and not isinstance(v.get("scenarios"), bool):
+                key = f"S{int(v['scenarios'])}"
+            _flatten(f"{prefix}.{key}", v, out)
     elif isinstance(obj, bool):
         pass
     elif isinstance(obj, (int, float)):
@@ -212,10 +247,13 @@ def _gate_for(key: str):
     return None, None
 
 
-def compare(old: dict, new: dict) -> dict:
-    """Diff two metric dicts (extract_metrics output).  Returns rows
-    for common keys plus the appeared/disappeared key lists."""
-    mo, mn = extract_metrics(old), extract_metrics(new)
+def compare(old: dict, new: dict,
+            _metrics: tuple[dict, dict] | None = None) -> dict:
+    """Diff two artifacts.  Returns rows for common keys plus the
+    appeared/disappeared key lists.  `_metrics` lets gate() pass
+    already-extracted metric maps so each artifact is flattened once
+    per invocation."""
+    mo, mn = _metrics or (extract_metrics(old), extract_metrics(new))
     rows = []
     for k in sorted(set(mo) & set(mn)):
         a, b = mo[k], mn[k]
@@ -244,12 +282,69 @@ def compare(old: dict, new: dict) -> dict:
     }
 
 
+def _meets(value: float, direction: str, bound: float) -> bool:
+    return value <= bound if direction == "up" else value >= bound
+
+
+def _milestone_rows(mo: dict, mn: dict, strict: bool) -> list[dict]:
+    """Evaluate MILESTONES over the new artifact's metrics (ratchet
+    semantics — see the table's comment).
+
+    A milestone key ABSENT from the new artifact is itself a failure
+    whenever the bound would have bound: dropping the phase from the
+    bench (or renaming the key) must not become a silent regression
+    path.  Ratchet mode fails a landed key that disappeared; strict
+    mode additionally fails a pattern with no match anywhere (strict
+    is the post-win bench CI mode — an artifact without the milestone
+    phases has no business passing it)."""
+    rows = []
+    for pat, direction, bound in MILESTONES:
+        matched_new = False
+        for k in sorted(mn):
+            if not re.search(pat, k):
+                continue
+            matched_new = True
+            new, old = mn[k], mo.get(k)
+            landed = old is not None and _meets(old, direction, bound)
+            binding = strict or landed
+            met = _meets(new, direction, bound)
+            rows.append({
+                "metric": k, "milestone": bound,
+                "direction": direction, "old": old, "new": new,
+                "binding": binding,
+                "regressed": binding and not met,
+                "status": ("met" if met
+                           else ("REGRESSED" if binding else "pending")),
+            })
+        if matched_new:
+            continue
+        old_hits = [k for k in sorted(mo) if re.search(pat, k)]
+        landed_old = [k for k in old_hits
+                      if _meets(mo[k], direction, bound)]
+        if strict or landed_old:
+            # readable stand-in when neither artifact carries the key
+            # (a raw regex is not a metric name)
+            fallback = pat.replace("\\.", ".").rstrip("$")
+            for k in (landed_old or old_hits or [fallback]):
+                rows.append({
+                    "metric": k, "milestone": bound,
+                    "direction": direction,
+                    "old": mo.get(k), "new": None,
+                    "binding": True, "regressed": True,
+                    "status": "MISSING"})
+    return rows
+
+
 def gate(old: dict, new: dict,
-         overrides: dict[str, float] | None = None) -> dict:
+         overrides: dict[str, float] | None = None,
+         milestones: bool = False) -> dict:
     """compare() with per-call threshold overrides ({key substring:
-    relative threshold}).  `ok` is the pass/fail verdict; the CLI maps
-    it to the exit code."""
-    rep = compare(old, new)
+    relative threshold}) plus the MILESTONE floors/ceilings.  `ok` is
+    the pass/fail verdict; the CLI maps it to the exit code.
+    `milestones=True` makes every milestone bind even when the old
+    artifact predates the win (strict mode)."""
+    mo, mn = extract_metrics(old), extract_metrics(new)
+    rep = compare(old, new, _metrics=(mo, mn))
     if overrides:
         for r in rep["rows"]:
             for sub, thr in overrides.items():
@@ -263,6 +358,28 @@ def gate(old: dict, new: dict,
                     r["regressed"] = worse > thr * abs(a)
         rep["regressions"] = [r for r in rep["rows"] if r["regressed"]]
         rep["ok"] = not rep["regressions"]
+    ms = _milestone_rows(mo, mn, milestones)
+    rep["milestones"] = ms
+    failed_ms = [r for r in ms if r["regressed"]]
+    if failed_ms:
+        # fold milestone failures into `regressions` in the compare-row
+        # schema (consumers iterate one list), deduped against metrics
+        # the relative gates already failed
+        already = {r["metric"] for r in rep["regressions"]}
+        for r in failed_ms:
+            if r["metric"] in already:
+                continue
+            delta = (None if r["old"] is None or r["new"] is None
+                     else r["new"] - r["old"])
+            rel = (delta / abs(r["old"])
+                   if delta is not None and r["old"] else None)
+            rep["regressions"].append({
+                "metric": r["metric"], "old": r["old"], "new": r["new"],
+                "delta": delta, "rel": rel, "gated": True,
+                "direction": r["direction"],
+                "threshold": r["milestone"], "regressed": True,
+                "milestone": r["milestone"], "status": r["status"]})
+        rep["ok"] = False
     if not rep["rows"]:
         # two artifacts with NO overlapping metrics cannot certify
         # anything — fail loudly rather than green-light a vacuous diff
@@ -276,9 +393,10 @@ def compare_paths(old_path: str, new_path: str) -> dict:
 
 
 def gate_paths(old_path: str, new_path: str,
-               overrides: dict[str, float] | None = None) -> dict:
+               overrides: dict[str, float] | None = None,
+               milestones: bool = False) -> dict:
     return gate(load_artifact(old_path), load_artifact(new_path),
-                overrides)
+                overrides, milestones=milestones)
 
 
 def render_compare(rep: dict, only_gated: bool = False) -> str:
@@ -290,6 +408,11 @@ def render_compare(rep: dict, only_gated: bool = False) -> str:
             "gated" if r["gated"] else "")
         L.append(f"{r['metric']:<52} {r['old']:>12.6g} -> "
                  f"{r['new']:>12.6g}  ({r['rel']:+7.2%})  {mark}".rstrip())
+    for r in rep.get("milestones") or []:
+        cmp_c = "<=" if r["direction"] == "up" else ">="
+        shown = "absent" if r["new"] is None else format(r["new"], ".6g")
+        L.append(f"milestone {r['metric']:<42} {shown:>12} "
+                 f"{cmp_c} {r['milestone']:g}  [{r['status']}]")
     if rep["disappeared"]:
         L.append(f"disappeared: {', '.join(rep['disappeared'][:8])}"
                  + (" ..." if len(rep["disappeared"]) > 8 else ""))

@@ -20,8 +20,15 @@ from mpisppy_tpu.ops import pdhg
 
 
 def _pdhg_opts(cfg) -> pdhg.PDHGOptions:
+    from mpisppy_tpu.ops import boxqp
+    prec = cfg.get("iter_precision")
+    # validate HERE (config time): a typo'd --iter-precision must fail
+    # before any jit trace, with the full alias list in the message
+    boxqp.as_precision(prec)
     return pdhg.PDHGOptions(
         tol=cfg.get("pdhg_tol", 1e-6),
+        iter_precision=prec,
+        pallas_pipeline=bool(cfg.get("pallas_pipeline", True)),
         lane_guard=bool(cfg.get("lane_guard", False)),
         guard_max_resets=cfg.get("guard_max_resets", 3),
         telemetry=bool(cfg.get("kernel_counters", False)))

@@ -118,6 +118,20 @@ class Config:
                            1e-6)
         self.add_to_config("subproblem_windows",
                            "PDHG restart windows per PH iteration", int, 8)
+        self.add_to_config("iter_precision",
+                           "PDHG iteration matvec precision alias: "
+                           "bf16x3 (3-pass bf16 — half the HBM bytes "
+                           "and MXU passes per matvec, ~4e-6 relative "
+                           "error, docs/precision.md) or bf16x6 (full "
+                           "f32, the default when unset).  Restart "
+                           "scoring, convergence tests and certificates "
+                           "always run at full precision regardless",
+                           str, None)
+        self.add_to_config("pallas_pipeline",
+                           "double-buffer the Pallas window kernel's "
+                           "scenario-tile DMA (prefetch next tile while "
+                           "the current one computes); disable to force "
+                           "the single-buffer grid kernel", bool, True)
 
     def two_sided_args(self):
         self.add_to_config("rel_gap", "relative termination gap", float,

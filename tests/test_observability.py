@@ -289,6 +289,93 @@ def test_gate_passes_r05_vs_r04_and_fails_on_regression(tmp_path):
     assert regress.gate_paths(r04, str(good_path))["ok"]
 
 
+def test_gate_r06_fixture_and_milestones(tmp_path):
+    """ISSUE 8 gate-fixture refresh: the committed r05->r06 pair must
+    gate green; the new absolute MILESTONE thresholds (S=10k
+    sec_per_iter <= 0.045, S=100k iters_per_sec >= 2) follow ratchet
+    semantics — pending on pre-win artifacts, strict-bindable via
+    --milestones, and permanently binding once an artifact has landed
+    the win; the committed overlap_frac keys fail the gate on a
+    synthetic drop."""
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    r06 = os.path.join(REPO, "BENCH_r06.json")
+    rep = regress.gate_paths(r05, r06)
+    assert rep["ok"], rep["regressions"]
+    # both milestone keys are present and reported pending (r06 carries
+    # the pre-win on-TPU measurements: 0.0601 s/iter, 1.46 iters/s)
+    ms = {r["metric"]: r for r in rep["milestones"]}
+    assert ms["measured_mfu.S10000.sec_per_iter"]["status"] == "pending"
+    assert ms["sweep_iters_per_sec.S100000.iters_per_sec"]["status"] \
+        == "pending"
+    assert not any(r["regressed"] for r in rep["milestones"])
+
+    # strict mode: the same pair FAILS until the wins land on hardware
+    from mpisppy_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main(["gate", r05, r06]) == 0
+    assert tel_main(["gate", r05, r06, "--milestones"]) == 2
+
+    # a post-win artifact meets the floors in strict mode...
+    won = json.load(open(r06))
+    won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
+    won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
+    won_path = tmp_path / "BENCH_won.json"
+    won_path.write_text(json.dumps(won))
+    rep2 = regress.gate_paths(r06, str(won_path), milestones=True)
+    assert rep2["ok"], rep2["regressions"]
+    assert all(r["status"] == "met" for r in rep2["milestones"])
+
+    # ...and then RATCHETS: a later artifact slipping past the floor
+    # fails WITHOUT --milestones even when the relative move is inside
+    # the +-10% band (0.044 -> 0.0462 is +5%; 2.2 -> 1.98 is -10%)
+    slip = json.load(open(r06))
+    slip["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.0462
+    slip["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 1.98
+    slip_path = tmp_path / "BENCH_slipped.json"
+    slip_path.write_text(json.dumps(slip))
+    rep3 = regress.gate_paths(str(won_path), str(slip_path))
+    assert not rep3["ok"]
+    failed = {r["metric"] for r in rep3["regressions"]}
+    assert "measured_mfu.S10000.sec_per_iter" in failed
+    assert "sweep_iters_per_sec.S100000.iters_per_sec" in failed
+
+    # a LANDED milestone key that disappears from the next artifact is
+    # a failure, not a silently-un-bound gate (dropping the bench phase
+    # must not become the regression escape hatch)
+    gone = json.load(open(r06))
+    del gone["parsed"]["measured_mfu"]
+    gone_path = tmp_path / "BENCH_phase_dropped.json"
+    gone_path.write_text(json.dumps(gone))
+    rep_gone = regress.gate_paths(str(won_path), str(gone_path))
+    assert not rep_gone["ok"]
+    assert any(r.get("status") == "MISSING"
+               and "measured_mfu" in r["metric"]
+               for r in rep_gone["regressions"])
+    # strict mode fails the absent key even when it never landed
+    rep_gone2 = regress.gate_paths(r06, str(gone_path), milestones=True)
+    assert any(r.get("status") == "MISSING" for r in rep_gone2["milestones"])
+    assert not rep_gone2["ok"]
+    # ...but ratchet mode lets a never-landed phase disappear quietly
+    rep_gone3 = regress.gate_paths(r06, str(gone_path))
+    assert not any(r.get("status") == "MISSING"
+                   for r in rep_gone3["milestones"])
+
+    # overlap_frac keys gate direction-aware on the committed fixture:
+    # a 35% drop in DMA/compute overlap at S=100k is a regression
+    drop = json.load(open(r06))
+    drop["parsed"]["device_profile"]["S100000"]["overlap_frac"] = 0.64
+    drop_path = tmp_path / "BENCH_overlap_drop.json"
+    drop_path.write_text(json.dumps(drop))
+    rep4 = regress.gate_paths(r06, str(drop_path))
+    assert not rep4["ok"]
+    assert any("overlap_frac" in r["metric"] for r in rep4["regressions"])
+    # while a RISING overlap (the double-buffer win direction) passes
+    rise = json.load(open(r06))
+    rise["parsed"]["device_profile"]["S100000"]["overlap_frac"] = 0.999
+    rise_path = tmp_path / "BENCH_overlap_rise.json"
+    rise_path.write_text(json.dumps(rise))
+    assert regress.gate_paths(r06, str(rise_path))["ok"]
+
+
 def test_gate_analyzer_reports_and_thresholds(tmp_path):
     rep = an.analyze_path(GOLDEN)
     a = tmp_path / "a.json"
@@ -403,7 +490,8 @@ def test_readme_claims_lint_catches_drift(tmp_path):
     fake.write_text(
         "intro prose\n\n"
         "Measured on one TPU v5 lite chip:\n\n"
-        "- reaches the gap in 999 s (12 iterations) at ~3.1x speedup\n"
+        "- reaches the gap in 999 s (12 iterations, bf16x6) at ~3.1x "
+        "speedup\n"
         "- config noise: 900 scenarios, 3-stage tree\n\n"
         "Out of scope: nothing.\n")
     pool = {12.0, 3.05}
@@ -413,3 +501,23 @@ def test_readme_claims_lint_catches_drift(tmp_path):
     assert len(vio) == 1 and "'999s'" in vio[0]
     assert tool.find_violations(readme=str(fake),
                                 pool=pool | {998.9}) == []
+    # ISSUE 8: a throughput bullet WITHOUT a precision-mode token is a
+    # violation even when every number is witnessed — wrapped bullet
+    # lines share the first line's disclosure
+    fake.write_text(
+        "Measured on one TPU v5 lite chip:\n\n"
+        "- reaches the gap in 999 s\n"
+        "- wrapped bullet at bf16x3 reaches\n"
+        "  the gap in 999 s too\n\n"
+        "Out of scope: nothing.\n")
+    vio2 = tool.find_violations(readme=str(fake), pool={999.0})
+    assert len(vio2) == 1 and "precision" in vio2[0]
+    # trailing section prose must NOT donate its token to the last
+    # bullet (a paragraph is not a bullet continuation)
+    fake.write_text(
+        "Measured on one TPU v5 lite chip:\n\n"
+        "- reaches the gap in 999 s\n\n"
+        "See docs/precision.md for the bf16x6 contract.\n\n"
+        "Out of scope: nothing.\n")
+    vio3 = tool.find_violations(readme=str(fake), pool={999.0})
+    assert len(vio3) == 1 and "precision" in vio3[0]

@@ -49,6 +49,14 @@ NUM_RE = re.compile(r"-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?")
 
 APPROX_REL_TOL = 0.10   # slack granted to "~"-marked approximations
 
+#: ISSUE 8: every measured-section bullet quoting a solver-throughput
+#: claim (seconds-to-gap, sec/iter, iters/s) must disclose the
+#: iteration-precision mode it was measured at (docs/precision.md) —
+#: bf16x3 halves the per-matvec byte traffic, so a throughput number
+#: without its mode is not a reproducible claim
+PRECISION_TOKENS = ("bf16x3", "bf16x6", "full precision")
+SPEED_UNITS = {"s", "sec", "seconds", "iters/s"}
+
 
 def _collect_numbers(obj, pool: set) -> None:
     """Every number in a JSON artifact — including numbers embedded in
@@ -100,9 +108,10 @@ def artifact_pool(repo: str = REPO) -> set:
     return pool
 
 
-def claims_in(text: str) -> list[tuple[str, float, int, str]]:
-    """(display, value, decimals, unit) perf claims in the measured
-    section; `display` keeps the ~ marker."""
+def _measured_section(text: str) -> list[str]:
+    """The measured-results block's lines — THE one slicing rule both
+    lints (number-witness and precision-disclosure) scan, so they can
+    never drift onto different sections."""
     lines = text.splitlines()
     start = next((i for i, ln in enumerate(lines)
                   if SECTION_START in ln), None)
@@ -110,13 +119,49 @@ def claims_in(text: str) -> list[tuple[str, float, int, str]]:
         return []
     end = next((i for i in range(start + 1, len(lines))
                 if lines[i].startswith(SECTION_END)), len(lines))
+    return lines[start:end]
+
+
+def claims_in(text: str) -> list[tuple[str, float, int, str]]:
+    """(display, value, decimals, unit) perf claims in the measured
+    section; `display` keeps the ~ marker."""
     out = []
-    for ln in lines[start:end]:
+    for ln in _measured_section(text):
         for m in CLAIM_RE.finditer(ln):
             approx, num, unit = m.group(1), m.group(2), m.group(3)
             decimals = len(num.split(".")[1]) if "." in num else 0
             out.append((approx + num + unit, float(num), decimals, unit))
     return out
+
+
+def undisclosed_precision_bullets(text: str) -> list[str]:
+    """Measured-section bullets carrying a speed-unit claim but no
+    precision-mode token.  Bullets are grouped ('- ' starts one;
+    indented lines continue it) so a disclosure anywhere in the bullet
+    covers its wrapped lines."""
+    bullets, cur = [], None
+    for ln in _measured_section(text):
+        if ln.lstrip().startswith("- "):
+            if cur is not None:
+                bullets.append(cur)
+            cur = ln
+        elif cur is not None and ln[:1] in (" ", "\t") and ln.strip():
+            cur += "\n" + ln   # indented wrapped line continues it
+        elif cur is not None:
+            # blank line or unindented prose ends the bullet — trailing
+            # section paragraphs must not donate their disclosure token
+            bullets.append(cur)
+            cur = None
+    if cur is not None:
+        bullets.append(cur)
+    bad = []
+    for b in bullets:
+        has_speed = any(m.group(3) in SPEED_UNITS
+                        for m in CLAIM_RE.finditer(b))
+        disclosed = any(tok in b.lower() for tok in PRECISION_TOKENS)
+        if has_speed and not disclosed:
+            bad.append(b.strip().splitlines()[0])
+    return bad
 
 
 def _matches(value: float, decimals: int, approx: bool, unit: str,
@@ -151,6 +196,12 @@ def find_violations(readme: str = README,
                 f"has no witness in BENCH_DETAIL.json / BENCH_r0*.json "
                 f"/ DEVICE_PROFILE.json — quote the committed "
                 f"artifact, not a local run")
+    for head in undisclosed_precision_bullets(text):
+        violations.append(
+            f"{os.path.basename(readme)}: throughput claim without an "
+            f"iteration-precision disclosure (need one of "
+            f"{PRECISION_TOKENS} in the bullet; docs/precision.md): "
+            f"{head[:80]!r}")
     return violations
 
 
