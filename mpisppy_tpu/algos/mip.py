@@ -46,6 +46,13 @@ from mpisppy_tpu.ops.bnb import BnBOptions
 # in-flight cap.  Results match the direct ops.bnb path within
 # certified-bound tolerances, and every bound keeps its certificate
 # (see the padding contract in dispatch/buckets.py).
+#
+# Failure semantics (docs/dispatch.md): under a configured dispatch
+# fault domain a quarantined solve raises dispatch.SolveFailed instead
+# of hanging.  decomposition_bnb absorbs per-node failures (the parent
+# bound stays a certified stand-in); the one-shot oracles
+# (lagrangian_mip_bound, evaluate_mip*, ef_mip) propagate SolveFailed
+# to their caller — a typed, catchable outcome, never a wedge.
 
 Array = jnp.ndarray
 
@@ -616,6 +623,7 @@ def decomposition_bnb(batch: ScenarioBatch, W,
     counter = 0
     heapq.heappush(heap, (-np.inf, counter, lo0, hi0))
     nodes = 0
+    failed_nodes = 0
 
     def scale(v):
         return max(1.0, abs(v)) if np.isfinite(v) else 1.0
@@ -645,7 +653,23 @@ def decomposition_bnb(batch: ScenarioBatch, W,
         tickets = [sched.submit(qpn, batch.d_col, int_cols, opts)
                    for qpn in qp_nodes]
         for (node_bound, lo, hi), ticket in zip(popped, tickets):
-            res = ticket.result()
+            try:
+                res = ticket.result()
+            except _dispatch.SolveFailed as e:
+                # quarantined node solve (docs/dispatch.md failure
+                # semantics): the node's PARENT bound is still a valid
+                # lower bound on everything under it, so folding it
+                # into the fathom floor keeps the reported outer bound
+                # certified — the node is abandoned (never re-queued:
+                # a poison node would loop forever), accounted, and the
+                # healthy nodes proceed
+                nodes += 1
+                failed_nodes += 1
+                fathom_floor = min(fathom_floor, node_bound)
+                _console.log(f"[ddbnb] node solve quarantined "
+                             f"({e.reason}): holding parent bound "
+                             f"{node_bound:.6g}", level=_console.DEBUG)
+                continue
             nodes += 1
             outer_s = np.asarray(res.outer)
             nb = float(np.sum(np.where(real, p * outer_s, 0.0)))
@@ -661,9 +685,21 @@ def decomposition_bnb(batch: ScenarioBatch, W,
                 key = tuple(np.round(cand[int_slots]).astype(int))
                 if key not in tried:
                     tried.add(key)
-                    ev = evaluate_mip(batch, jnp.asarray(cand, np.float32),
-                                      opts)
-                    if ev["feasible"] and ev["value"] < inner:
+                    try:
+                        ev = evaluate_mip(batch,
+                                          jnp.asarray(cand, np.float32),
+                                          opts)
+                    except _dispatch.SolveFailed as e:
+                        # the incumbent candidate eval is optional work:
+                        # a quarantined eval costs one candidate, never
+                        # the run (the search keeps its bracket)
+                        _console.log(f"[ddbnb] incumbent eval "
+                                     f"quarantined ({e.reason}); "
+                                     f"skipping candidate",
+                                     level=_console.DEBUG)
+                        ev = None
+                    if ev is not None and ev["feasible"] \
+                            and ev["value"] < inner:
                         inner, xhat_best = ev["value"], ev["xhat"]
                 spread = (p[:, None] * np.abs(
                     x_non - xbar[None, :])).sum(0)[int_slots]
@@ -708,7 +744,8 @@ def decomposition_bnb(batch: ScenarioBatch, W,
     outer = min(open_min, fathom_floor, inner)
     gap = (inner - outer) / scale(inner) if np.isfinite(inner) else float("inf")
     return {"inner": inner, "outer": outer, "gap": gap,
-            "xhat": xhat_best, "nodes": nodes}
+            "xhat": xhat_best, "nodes": nodes,
+            "failed_nodes": failed_nodes}
 
 
 @dataclasses.dataclass

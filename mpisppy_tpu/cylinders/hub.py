@@ -86,14 +86,33 @@ class Hub(SPCommunicator):
         # adopt the process-default dispatch scheduler into this run:
         # its megabatch events then carry this hub's run id and join
         # the trace exactly (the scheduler is configured by the CLI
-        # before any hub exists, so it cannot know the id itself)
+        # before any hub exists, so it cannot know the id itself) —
+        # and arm the run's fault plan on its dispatch seams so chaos
+        # runs fault the dispatch layer through the same plan object
         try:
             from mpisppy_tpu import dispatch as _dispatch
             sched = _dispatch.get_scheduler(create=False)
             if sched is not None and not sched.run:
                 sched.run = self.run_id
+            if sched is not None and plan is not None \
+                    and sched.fault_plan is None:
+                sched.fault_plan = plan
         except Exception:
             pass
+        # hub progress watchdog (docs/resilience.md): no hub iteration
+        # or certified-bound movement for watchdog_budget_s wall
+        # seconds -> flight-recorder dump + the configured action
+        # (checkpoint-and-abort exit 75, or degrade the dispatch
+        # scheduler to direct un-coalesced mode)
+        self._watchdog = None
+        budget = self.options.get("watchdog_budget_s")
+        if budget:
+            from mpisppy_tpu.resilience.watchdog import HubWatchdog
+            self._watchdog = HubWatchdog(
+                self, float(budget),
+                action=self.options.get("watchdog_action", "abort"),
+                interval_s=self.options.get("watchdog_interval_s"),
+            ).start()
         self._profiler = None
         if self.options.get("profile_dir"):
             self._profiler = _prof.ProfilerSession(
@@ -524,6 +543,9 @@ class PHHub(Hub):
         self._harvest_kernel_counters()
         self._harvest_dispatch_stats()
         abs_gap, rel_gap = self.compute_gaps()
+        if self._watchdog is not None:
+            self._watchdog.beat(self._iter, self.BestOuterBound,
+                                self.BestInnerBound)
         extra = self._trace_extra()
         self._emit(tel.HUB_ITERATION, **{
             "iter": self._iter, **extra,
@@ -912,6 +934,10 @@ class PHHub(Hub):
         return self.opt.ph_main()
 
     def finalize(self):
+        # the run is terminating on purpose: the watchdog must not
+        # trip on the (possibly long) finalization work
+        if self._watchdog is not None:
+            self._watchdog.stop()
         # one last harvest so late async results count; fused drivers
         # first sync their pipelined scalar cache to the final iterate
         if hasattr(self.opt, "flush_scalars"):

@@ -32,6 +32,7 @@ from mpisppy_tpu.dispatch.buckets import (   # noqa: F401
 from mpisppy_tpu.dispatch.compilewatch import CompileWatch  # noqa: F401
 from mpisppy_tpu.dispatch.scheduler import (  # noqa: F401
     DispatchOptions,
+    SolveFailed,
     SolveScheduler,
     configure,
     current_hub_iter,

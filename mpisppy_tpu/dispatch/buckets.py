@@ -142,6 +142,26 @@ def slice_result(res, S: int):
                             and a.shape[0] > S) else a, res)
 
 
+def balanced_split(sizes) -> int:
+    """Bisection point for a failing megabatch's request list
+    (scheduler._solve_recover): the request index that best halves the
+    LANE count, clamped to keep both halves non-empty.  Splitting by
+    lanes (not request count) keeps the bisection's isolation depth
+    log2(lanes-weighted) when one request dwarfs the rest — and both
+    halves land closer to a shared ladder rung."""
+    sizes = list(sizes)
+    if len(sizes) < 2:
+        raise ValueError("need at least two requests to split")
+    half = sum(sizes) / 2.0
+    acc, best_mid, best_err = 0, 1, float("inf")
+    for i, s in enumerate(sizes[:-1]):
+        acc += s
+        err = abs(acc - half)
+        if err < best_err:
+            best_err, best_mid = err, i + 1
+    return best_mid
+
+
 def shape_signature(qp, d_col) -> tuple:
     """The registry key of a dispatch's DEVICE-FACING shape: batch
     rung, (n, m), dtype, the A storage kind, and which fields carry a

@@ -28,12 +28,30 @@
 #     already-warm bucket increments dispatch_unexpected_recompiles
 #     (and raises under --dispatch-compile-guard) instead of silently
 #     storming.
+#   * FAULT DOMAIN (deadlines / retry / bisection quarantine;
+#     ISSUE 9, docs/dispatch.md failure semantics).  Every ticket may
+#     carry a deadline (per-submit or the options default) and
+#     result() takes a timeout — a caller can NEVER block past the
+#     earlier of the two; expiry raises a typed SolveFailed instead of
+#     hanging.  Every megabatch dispatch may carry a timeout
+#     (dispatch_timeout_s): a hung or raising dispatch (XLA
+#     RuntimeError, OOM, a NaN-poisoned batch) is retried with
+#     exponential backoff up to retry_max; a window still failing after
+#     its budget is BISECTED — split in request halves and re-solved —
+#     until the poison request(s) are isolated and QUARANTINED (their
+#     tickets resolve with SolveFailed, the healthy halves proceed).
+#     The dispatcher daemon is supervised: if the thread dies, every
+#     queued ticket fails fast with SolveFailed("dispatcher-died")
+#     instead of waiting forever, and the next submit restarts it.
+#     PreemptionError and AssertionError (the compile guard) are never
+#     retried — they must stay loud.
 #
 # Everything is recorded in the process metrics REGISTRY (gauges:
 # queue depth, in-flight, occupancy; counters: batches, lanes, pad
-# lanes, compiles) and, when a bus is attached, emitted as one
-# "dispatch" event per megabatch — see docs/dispatch.md for the field
-# tables.
+# lanes, compiles, retries, quarantined lanes) and, when a bus is
+# attached, emitted as "dispatch" / "dispatch-retry" /
+# "dispatch-quarantine" / "watchdog" events — see docs/dispatch.md for
+# the field tables.
 ###############################################################################
 from __future__ import annotations
 
@@ -79,27 +97,104 @@ class DispatchOptions:
     pad_batch: bool = True       # pad megabatches up the bucket ladder
     bucket_growth: float = 2.0   # geometric ladder growth factor
     compile_guard: bool = False  # raise on a warm-bucket recompile
+    # -- fault domain (ISSUE 9; docs/dispatch.md failure semantics) ------
+    dispatch_timeout_s: float | None = None  # per-attempt solve timeout
+    retry_max: int = 2           # retries per request set before bisecting
+    retry_backoff_s: float = 0.05  # base backoff, doubled per retry
+    deadline_s: float | None = None  # default per-ticket deadline
+
+
+class SolveFailed(RuntimeError):
+    """Typed terminal outcome of a failed solve request — what a
+    `solve_mip`/`result()` caller observes instead of a hang
+    (docs/dispatch.md failure-semantics table).
+
+    reason: 'deadline'         ticket deadline / result(timeout) expired
+            'timeout'          every dispatch attempt hit
+                               dispatch_timeout_s (retries exhausted)
+            'exception'        every dispatch attempt raised (retries
+                               exhausted; `detail` holds the last error)
+            'dispatcher-died'  the dispatcher daemon died with this
+                               request queued (fail fast, not forever)
+    attempts counts the solve attempts this request rode in (0 for
+    deadline/dispatcher failures); lanes is the request's batch size —
+    the quarantine accounting unit."""
+
+    def __init__(self, reason: str, detail: str = "", attempts: int = 0,
+                 lanes: int = 0):
+        self.reason = reason
+        self.detail = detail
+        self.attempts = attempts
+        self.lanes = lanes
+        super().__init__(
+            f"solve failed ({reason}"
+            + (f" after {attempts} attempt(s)" if attempts else "")
+            + (f"): {detail}" if detail else ")"))
+
+
+class _DispatchTimeout(RuntimeError):
+    """Internal: one dispatch attempt exceeded dispatch_timeout_s."""
 
 
 class SolveTicket:
     """Future for one submitted solve; result() blocks (and, when the
-    owning window is still open, dispatches it inline — the caller's
-    thread is the natural place to run its own megabatch)."""
+    owning window is still open, dispatches it — inline on the caller's
+    thread for unbounded waits, via the dispatcher daemon when a
+    deadline/timeout bounds the wait so the caller can never be pinned
+    inside a hung solve)."""
 
-    def __init__(self, scheduler, window):
+    def __init__(self, scheduler, window, lanes: int = 0,
+                 deadline: float | None = None, sid: int = -1):
         self._scheduler = scheduler
         self._window = window
         self._event = threading.Event()
         self._result = None
         self._exc = None
+        self._lanes = lanes
+        self._deadline = deadline     # absolute perf_counter stamp
+        self.sid = sid                # scheduler-assigned submit id
+                                      # (joins quarantine events and
+                                      # FaultPlan dispatch seams)
 
     def done(self) -> bool:
         return self._event.is_set()
 
-    def result(self):
+    def result(self, timeout: float | None = None):
+        """Block for the result.  A LIVE ticket deadline and `timeout`
+        (seconds) each bound the wait — past the earlier one this
+        raises SolveFailed('deadline'): a ticket can never hang its
+        caller.  After the deadline has expired, a bare result() keeps
+        raising, but an EXPLICIT timeout grants a fresh recovery wait
+        (the solve may still land late) and a call after it lands
+        returns the result.  A quarantined/failed request raises its
+        SolveFailed."""
         if not self._event.is_set():
-            self._scheduler._drive(self._window)
-            self._event.wait()
+            now = time.perf_counter()
+            expired = self._deadline is not None \
+                and self._deadline <= now
+            if expired and timeout is None:
+                raise SolveFailed(
+                    "deadline", lanes=self._lanes,
+                    detail="ticket deadline expired with the solve "
+                           "still outstanding")
+            bound = None if timeout is None else now + timeout
+            if self._deadline is not None and not expired:
+                bound = self._deadline if bound is None \
+                    else min(bound, self._deadline)
+            if bound is None:
+                self._scheduler._drive(self._window, cause="inline")
+                self._event.wait()
+            else:
+                # bounded wait: hand the window to the dispatcher (a
+                # caller driving inline would sit inside solve_fn past
+                # its own deadline) and wait out the bound
+                self._scheduler._expedite(self._window)
+                if not self._event.wait(
+                        max(0.0, bound - time.perf_counter())):
+                    raise SolveFailed(
+                        "deadline", lanes=self._lanes,
+                        detail="ticket deadline/timeout expired with "
+                               "the solve still outstanding")
         if self._exc is not None:
             raise self._exc
         return self._result
@@ -109,15 +204,18 @@ class _Window:
     """One open coalescing window for a key: requests accumulate until
     the window is claimed by a dispatching thread and frozen."""
 
-    __slots__ = ("key", "reqs", "tickets", "t0", "claimed", "frozen")
+    __slots__ = ("key", "reqs", "tickets", "t0", "claimed", "frozen",
+                 "due", "cause")
 
     def __init__(self, key):
         self.key = key
-        self.reqs: list = []      # (qp, d_col, int_cols, opts, kwargs)
+        self.reqs: list = []      # (qp, d_col, int_cols, opts, kwargs, sid)
         self.tickets: list = []
         self.t0 = time.perf_counter()
         self.claimed = False
         self.frozen = False
+        self.due = False          # a bounded result() wait expedites
+        self.cause = "timer"      # why the window dispatched (stats)
 
 
 class SolveScheduler:
@@ -126,7 +224,8 @@ class SolveScheduler:
     real branch-and-bound); the default is ops.bnb.solve_mip."""
 
     def __init__(self, options: DispatchOptions = DispatchOptions(),
-                 solve_fn=None, bus=None, run: str = ""):
+                 solve_fn=None, bus=None, run: str = "",
+                 fault_plan=None):
         if solve_fn is None:
             from mpisppy_tpu.ops import bnb as _bnb
             solve_fn = _bnb.solve_mip
@@ -134,6 +233,10 @@ class SolveScheduler:
         self.solve_fn = solve_fn
         self.bus = bus
         self.run = run
+        # chaos seams (resilience/faults.DispatchFault; armed by tests
+        # and by the hub when its options carry a fault_plan) — host
+        # dispatch path only, zero jit-graph impact
+        self.fault_plan = fault_plan
         self.ladder = _buckets.BucketLadder(options.bucket_growth)
         self._lock = threading.Lock()
         self._sem = threading.Semaphore(max(1, options.max_inflight))
@@ -142,6 +245,9 @@ class SolveScheduler:
         self._dispatcher = None
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self._degraded = False
+        self._next_sid = 0                # submit ids (fault-seam joins)
+        self._attempts = 0                # dispatch attempts incl retries
         # -- stats (all also mirrored into the metrics REGISTRY) ----------
         self._buckets: dict = {}          # shape signature -> dispatches
         self._inflight = 0
@@ -152,6 +258,18 @@ class SolveScheduler:
         self._coalesced_lanes = 0         # lanes that shared a dispatch
         self._unexpected_recompiles = 0
         self._dispatch_compiles = 0       # compiles DURING solve windows
+        self._retries = 0                 # re-dispatched attempt count
+        self._quarantined_lanes = 0       # lanes resolved as SolveFailed
+        self._quarantined_requests = 0
+        self._dispatcher_deaths = 0
+        # why windows dispatched: timer (admission deadline expiry),
+        # size (max_batch reached), inline (a caller's unbounded
+        # result()), expedite (a deadline-bounded result()), overflow
+        # (displaced by the lane cap), close (scheduler flush) — the
+        # stats() split that lets the analyzer attribute occupancy loss
+        # to admission timeouts vs size-forced dispatch (ISSUE 9
+        # satellite)
+        self._by_cause: dict = {}
 
     # -- public API -------------------------------------------------------
     def solve_mip(self, qp, d_col, int_cols, opts=None, **kwargs):
@@ -162,16 +280,22 @@ class SolveScheduler:
         return self.submit(qp, d_col, int_cols, opts, **kwargs).result()
 
     def submit(self, qp, d_col, int_cols, opts=None,
-               **kwargs) -> SolveTicket:
+               deadline_s: float | None = None, **kwargs) -> SolveTicket:
         """Enqueue one solve; returns a ticket.  Same-key submits
         coalesce into one megabatch dispatch.  The caller may submit
         many and then collect results — the first result() call drives
-        the (single, coalesced) dispatch."""
+        the (single, coalesced) dispatch.  `deadline_s` (default:
+        options.deadline_s) bounds how long result() may ever block on
+        this ticket; expiry raises SolveFailed('deadline')."""
         if opts is None:
             from mpisppy_tpu.ops.bnb import BnBOptions
             opts = BnBOptions()
         S = int(qp.c.shape[0])
         key = self._request_key(qp, d_col, int_cols, opts, kwargs)
+        if deadline_s is None:
+            deadline_s = self.options.deadline_s
+        deadline = None if deadline_s is None \
+            else time.perf_counter() + float(deadline_s)
         overflow = None
         with self._lock:
             if self._closed:
@@ -190,8 +314,11 @@ class SolveScheduler:
                     overflow = win
                 win = _Window(key)
                 self._pending[key] = win
-            ticket = SolveTicket(self, win)
-            win.reqs.append((qp, d_col, int_cols, opts, kwargs))
+            sid = self._next_sid
+            self._next_sid += 1
+            ticket = SolveTicket(self, win, lanes=S, deadline=deadline,
+                                 sid=sid)
+            win.reqs.append((qp, d_col, int_cols, opts, kwargs, sid))
             win.tickets.append(ticket)
             full = (sum(r[0].c.shape[0] for r in win.reqs)
                     >= self.options.max_batch)
@@ -200,10 +327,24 @@ class SolveScheduler:
                 # submits whether or not coalescing is on
                 self._ensure_dispatcher()
             self._wake.notify_all()
+        # full/overflow windows normally dispatch on THIS thread (the
+        # submitting threads are what provide dispatch concurrency up
+        # to max_inflight) — but a deadline-carrying submit with NO
+        # dispatch timeout would then sit inside an unbounded solve_fn
+        # before ever reaching result(), wedging past its own deadline;
+        # in that mode hand the window to the dispatcher instead
+        inline_ok = deadline is None \
+            or self.options.dispatch_timeout_s is not None
         if overflow is not None:
-            self._drive(overflow)
+            if inline_ok:
+                self._drive(overflow, cause="overflow")
+            else:
+                self._expedite(overflow)
         if full:
-            self._drive(win)
+            if inline_ok:
+                self._drive(win, cause="size")
+            else:
+                self._expedite(win)
         return ticket
 
     def stats(self) -> dict:
@@ -227,7 +368,33 @@ class SolveScheduler:
                 "inflight_max": self._inflight_max,
                 "queue_depth": sum(len(w.reqs)
                                    for w in self._pending.values()),
+                # -- fault domain (ISSUE 9) -------------------------------
+                "retries_total": self._retries,
+                "quarantined_lanes": self._quarantined_lanes,
+                "quarantined_requests": self._quarantined_requests,
+                "dispatcher_deaths": self._dispatcher_deaths,
+                "degraded": self._degraded,
+                # why windows dispatched (timer = admission deadline
+                # expiry, size = lane cap, inline/expedite = a blocking
+                # caller, overflow, close) — the occupancy-attribution
+                # split (a timer-heavy mix under load means the window
+                # never fills before its admission deadline)
+                "by_cause": dict(self._by_cause),
             }
+
+    def degrade(self) -> None:
+        """Watchdog action (resilience/watchdog.py): drop to direct,
+        un-coalesced dispatch — every later submit dispatches solo, the
+        coalescing/admission machinery leaves the suspect path.  Shape
+        padding stays on (the jit cache must stay bounded even in the
+        degraded mode)."""
+        with self._lock:
+            self.options = dataclasses.replace(self.options,
+                                               coalesce=False)
+            self._degraded = True
+        # observability rides the tripping watchdog's own event plus
+        # the degraded flag in stats()/the hub's per-sync stats row —
+        # a synthetic megabatch row here would pollute the audit
 
     def close(self):
         """Flush every open window and stop the dispatcher thread."""
@@ -236,7 +403,7 @@ class SolveScheduler:
             wins = [w for w in self._pending.values() if not w.claimed]
             self._wake.notify_all()
         for w in wins:
-            self._drive(w)
+            self._drive(w, cause="close")
         t = self._dispatcher
         if t is not None and t.is_alive():
             t.join(timeout=5.0)
@@ -282,13 +449,28 @@ class SolveScheduler:
         self._dispatcher.start()
 
     def _dispatch_loop(self):
+        """Supervised daemon body: the inner loop fires due windows;
+        ANY escape — including an injected dispatcher kill — fails
+        every queued ticket fast (SolveFailed('dispatcher-died'))
+        instead of leaving them to wait on a dead thread.  The next
+        submit restarts the daemon (see _ensure_dispatcher)."""
+        try:
+            self._dispatch_loop_inner()
+        except BaseException as e:  # noqa: BLE001 — the supervisor seam
+            self._on_dispatcher_death(e)
+
+    def _dispatch_loop_inner(self):
         wait_s = max(self.options.max_wait_ms, 0.1) / 1e3
         while True:
+            plan = self.fault_plan
+            if plan is not None:
+                plan.maybe_kill_dispatcher()
             with self._lock:
                 now = time.perf_counter()
                 open_w = [w for w in self._pending.values()
                           if not w.claimed]
-                due = [w for w in open_w if now - w.t0 >= wait_s]
+                due = [w for w in open_w
+                       if w.due or now - w.t0 >= wait_s]
                 if not due:
                     if self._closed:
                         return
@@ -304,15 +486,54 @@ class SolveScheduler:
                         self._wake.wait()
                     continue
             for w in due:
-                self._drive(w)
+                self._drive(w, cause="expedite" if w.due else "timer")
 
-    def _drive(self, win: _Window):
+    def _on_dispatcher_death(self, exc: BaseException):
+        """Fail-fast fan-out for a dead dispatcher thread: every ticket
+        still queued in an unclaimed window resolves with a typed
+        SolveFailed NOW (never a hang), the queue empties, and a
+        watchdog event records the death."""
+        with self._lock:
+            wins = [w for w in self._pending.values() if not w.claimed]
+            for w in wins:
+                w.claimed = True
+                w.frozen = True
+            self._pending = {}
+            self._dispatcher_deaths += 1
+        failed = 0
+        for w in wins:
+            for t in w.tickets:
+                if not t.done():
+                    t._exc = SolveFailed(
+                        "dispatcher-died", lanes=t._lanes,
+                        detail=f"{type(exc).__name__}: {exc}")
+                    t._event.set()
+                    failed += 1
+        _metrics.REGISTRY.inc("dispatch_dispatcher_deaths_total")
+        self._emit_event(
+            "watchdog", component="dispatcher", action="fail-fast",
+            failed_tickets=failed,
+            error=f"{type(exc).__name__}: {exc}")
+
+    def _expedite(self, win: _Window):
+        """A deadline-bounded result() wait: mark the window due and
+        wake the dispatcher so it fires without the caller having to
+        sit inside solve_fn itself."""
+        with self._lock:
+            if win.claimed:
+                return
+            win.due = True
+            self._ensure_dispatcher()
+            self._wake.notify_all()
+
+    def _drive(self, win: _Window, cause: str = "inline"):
         """Claim-and-run a window; loses the race gracefully when
         another thread (or the dispatcher) got there first."""
         with self._lock:
             if win.claimed:
                 return
             win.claimed = True
+            win.cause = cause
         try:
             self._run_window(win)
         except BaseException as e:  # noqa: BLE001 — fanned out below
@@ -344,23 +565,160 @@ class SolveScheduler:
                 _metrics.REGISTRY.set_gauge("dispatch_inflight",
                                             self._inflight)
             t_launch = time.perf_counter()
-            res, sizes, S_pad, sig = self._solve_merged(reqs)
-            off = 0
-            for t, S in zip(tickets, sizes):
-                # per-request slices exclude the pad lanes automatically
-                # (pads sit past the last real lane)
-                t._result = jax.tree_util.tree_map(
-                    lambda a, o=off, s=S: a[o:o + s]
-                    if getattr(a, "ndim", 0) >= 1 else a, res)
-                t._event.set()
-                off += S
-            self._record(win, sizes, S_pad, sig, t_launch)
+            self._solve_recover(win, reqs, tickets, t_launch)
         finally:
             with self._lock:
                 self._inflight -= 1
                 _metrics.REGISTRY.set_gauge("dispatch_inflight",
                                             self._inflight)
             self._sem.release()
+
+    def _solve_recover(self, win: _Window, reqs, tickets,
+                       t_launch: float, bisected: bool = False):
+        """The fault-domain driver (ISSUE 9): solve this request set
+        with retry + exponential backoff; a set still failing after its
+        budget BISECTS into request halves (each with a fresh budget —
+        recursion depth is log2(requests), total attempts bounded by
+        (retry_max+1) * (2*requests - 1)); a single request that still
+        fails is QUARANTINED — its ticket resolves with a typed
+        SolveFailed and every healthy sibling proceeds.  Non-retryable
+        escapes (preemption, the compile guard's AssertionError,
+        KeyboardInterrupt/SystemExit) propagate immediately to _drive's
+        fan-out."""
+        from mpisppy_tpu.resilience.faults import PreemptionError
+        last: BaseException | None = None
+        attempts = 0
+        for attempt in range(max(0, self.options.retry_max) + 1):
+            if attempt:
+                backoff = self.options.retry_backoff_s * (2 ** (attempt - 1))
+                self._retry_note(win, reqs, attempt, last, backoff)
+                time.sleep(backoff)
+            attempts += 1
+            try:
+                res, sizes, S_pad, sig = self._solve_merged(reqs)
+            except (PreemptionError, AssertionError):
+                raise          # must stay loud: shutdown / compile guard
+            except Exception as e:  # noqa: BLE001 — the retryable class
+                last = e
+                continue
+            self._deliver(win, reqs, tickets, res, sizes)
+            self._record(win, sizes, S_pad, sig, t_launch)
+            return
+        if len(reqs) > 1:
+            # the poison is somewhere in this set: isolate by
+            # lane-balanced halves (buckets.balanced_split)
+            mid = _buckets.balanced_split(
+                [int(r[0].c.shape[0]) for r in reqs])
+            self._solve_recover(win, reqs[:mid], tickets[:mid],
+                                t_launch, bisected=True)
+            self._solve_recover(win, reqs[mid:], tickets[mid:],
+                                t_launch, bisected=True)
+            return
+        self._quarantine(win, reqs[0], tickets[0], attempts, last,
+                         bisected)
+
+    def _solve_attempt(self, reqs, qp, d_col, int_cols, opts, kwargs):
+        """One bounded solve attempt.  With dispatch_timeout_s set the
+        solve runs on a worker thread and a hang becomes a typed
+        _DispatchTimeout after the budget (the abandoned worker keeps
+        the device busy until XLA returns — retry semantics on real
+        hardware are therefore 'wait out the budget, then re-enqueue',
+        not a device-side cancel; docs/dispatch.md).  The chaos seam
+        runs INSIDE the attempt so injected hangs consume the timeout
+        exactly like real ones."""
+        with self._lock:      # concurrent dispatch threads share the
+            idx = self._attempts          # attempt index sequence
+            self._attempts += 1
+        plan = self.fault_plan
+
+        def run():
+            if plan is not None:
+                plan.before_dispatch(idx, [r[5] for r in reqs])
+            return self.solve_fn(qp, d_col, int_cols, opts, **kwargs)
+
+        timeout = self.options.dispatch_timeout_s
+        if timeout is None:
+            return run()
+        box: dict = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                box["res"] = run()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["exc"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="mpisppy-tpu-dispatch-solve")
+        t.start()
+        if not done.wait(float(timeout)):
+            raise _DispatchTimeout(
+                f"dispatch exceeded its {timeout}s timeout "
+                f"(attempt {idx})")
+        if "exc" in box:
+            raise box["exc"]
+        return box["res"]
+
+    def _deliver(self, win: _Window, reqs, tickets, res, sizes):
+        off = 0
+        plan = self.fault_plan
+        for t, S, r in zip(tickets, sizes, reqs):
+            if plan is not None and plan.drop_ticket(r[5]):
+                # injected result loss: the ticket stays unresolved and
+                # its deadline converts the would-be hang into a typed
+                # SolveFailed at the caller
+                off += S
+                continue
+            # per-request slices exclude the pad lanes automatically
+            # (pads sit past the last real lane)
+            t._result = jax.tree_util.tree_map(
+                lambda a, o=off, s=S: a[o:o + s]
+                if getattr(a, "ndim", 0) >= 1 else a, res)
+            t._event.set()
+            off += S
+
+    def _retry_note(self, win: _Window, reqs, attempt: int,
+                    exc: BaseException | None, backoff_s: float):
+        with self._lock:
+            self._retries += 1
+        _metrics.REGISTRY.inc("dispatch_retries_total")
+        self._emit_event(
+            "dispatch-retry", attempt=attempt,
+            requests=len(reqs),
+            lanes=sum(int(r[0].c.shape[0]) for r in reqs),
+            backoff_s=backoff_s,
+            error="" if exc is None else f"{type(exc).__name__}: {exc}")
+
+    def _quarantine(self, win: _Window, req, ticket, attempts: int,
+                    exc: BaseException | None, bisected: bool):
+        """Terminal isolation of one poisoned request: the ticket
+        resolves with SolveFailed (reason timeout/exception), the lanes
+        are accounted, and the quarantine is observable."""
+        lanes = int(req[0].c.shape[0])
+        reason = "timeout" if isinstance(exc, _DispatchTimeout) \
+            else "exception"
+        detail = "" if exc is None else f"{type(exc).__name__}: {exc}"
+        with self._lock:
+            self._quarantined_lanes += lanes
+            self._quarantined_requests += 1
+        _metrics.REGISTRY.inc("dispatch_quarantined_lanes_total", lanes)
+        _metrics.REGISTRY.inc("dispatch_quarantined_requests_total")
+        self._emit_event(
+            "dispatch-quarantine", submit=req[5], lanes=lanes,
+            attempts=attempts, reason=reason, bisected=bisected,
+            error=detail)
+        if not ticket.done():
+            ticket._exc = SolveFailed(reason, detail=detail,
+                                      attempts=attempts, lanes=lanes)
+            ticket._event.set()
+
+    def _emit_event(self, kind: str, **data):
+        if self.bus is None:
+            return
+        self.bus.emit(kind, run=self.run, cyl="dispatch",
+                      hub_iter=_hub_iter, **data)
 
     def _solve_merged(self, reqs):
         """Concatenate the window's requests, pad up the ladder, solve.
@@ -385,7 +743,8 @@ class SolveScheduler:
         sig = _buckets.shape_signature(qp, d_col) + (opts,)
         warm = sig in self._buckets
         before = self._watch.total()
-        res = self.solve_fn(qp, d_col, int_cols, opts, **kwargs)
+        res = self._solve_attempt(reqs, qp, d_col, int_cols, opts,
+                                  kwargs)
         compiled = self._watch.total() - before
         self._dispatch_compiles += compiled
         if warm and compiled and self._inflight == 1:
@@ -460,6 +819,8 @@ class SolveScheduler:
             self._pad_lanes += S_pad - real
             if len(sizes) > 1:
                 self._coalesced_lanes += real
+            self._by_cause[win.cause] = \
+                self._by_cause.get(win.cause, 0) + 1
             queue_depth = sum(len(w.reqs) for w in self._pending.values())
         R = _metrics.REGISTRY
         R.inc("dispatch_batches_total")
@@ -478,7 +839,7 @@ class SolveScheduler:
                 requests=len(sizes), lanes=real, padded_to=S_pad,
                 occupancy=occ, bucket=list(sig[:3]),
                 wait_ms=1e3 * (t_launch - win.t0),
-                queue_depth=queue_depth,
+                queue_depth=queue_depth, cause=win.cause,
                 inflight_max=self._inflight_max)
 
 
@@ -520,6 +881,8 @@ def configure(options: DispatchOptions | None = None, bus=None,
 def from_cfg(cfg, bus=None, run: str = "") -> SolveScheduler:
     """Build + install the default scheduler from the dispatch_args
     Config group (utils/config.py)."""
+    timeout = cfg.get("dispatch_timeout_s")
+    deadline = cfg.get("dispatch_deadline_s")
     return configure(DispatchOptions(
         coalesce=bool(cfg.get("dispatch_coalesce", True)),
         max_batch=int(cfg.get("dispatch_max_batch", 4096)),
@@ -528,6 +891,10 @@ def from_cfg(cfg, bus=None, run: str = "") -> SolveScheduler:
         pad_batch=bool(cfg.get("dispatch_pad", True)),
         bucket_growth=float(cfg.get("dispatch_bucket_growth", 2.0)),
         compile_guard=bool(cfg.get("dispatch_compile_guard", False)),
+        dispatch_timeout_s=None if timeout is None else float(timeout),
+        retry_max=int(cfg.get("dispatch_retry_max", 2)),
+        retry_backoff_s=float(cfg.get("dispatch_retry_backoff_s", 0.05)),
+        deadline_s=None if deadline is None else float(deadline),
     ), bus=bus, run=run)
 
 

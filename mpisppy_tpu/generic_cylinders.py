@@ -475,11 +475,20 @@ def _spin_and_report(cfg, module, hub, spokes, names, specs):
         import math
         return v if isinstance(v, (int, float)) and math.isfinite(v) \
             else None
+    # fault-domain accounting (docs/resilience.md): a run that leaned
+    # on dispatch retries/quarantine or tripped the watchdog says so in
+    # its machine-readable result line, not only in the trace
+    from mpisppy_tpu import dispatch as _dispatch
+    dstats = _dispatch.scheduler_stats() or {}
+    wd = getattr(wheel.spcomm, "_watchdog", None)
     print(json.dumps({  # telemetry: allow-print
         "outer_bound": _finite(wheel.BestOuterBound),
         "inner_bound": _finite(wheel.BestInnerBound),
         "abs_gap": _finite(abs_gap), "rel_gap": _finite(rel_gap),
         "iterations": wheel.spcomm._iter,
+        "dispatch_retries": dstats.get("retries_total", 0),
+        "dispatch_quarantined_lanes": dstats.get("quarantined_lanes", 0),
+        "watchdog_trips": 0 if wd is None else wd.trips,
     }))
     return wheel
 

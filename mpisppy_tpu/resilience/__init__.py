@@ -1,4 +1,5 @@
 from mpisppy_tpu.resilience.faults import (  # noqa: F401
-    CheckpointFault, FaultPlan, LaneFault, PreemptionError,
-    SimulatedPreemption, SpokeBoundFault,
+    CheckpointFault, DispatchFault, DispatchPoison, FaultPlan, LaneFault,
+    PreemptionError, SimulatedPreemption, SpokeBoundFault,
 )
+from mpisppy_tpu.resilience.watchdog import HubWatchdog  # noqa: F401

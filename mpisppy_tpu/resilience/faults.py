@@ -23,7 +23,16 @@
 #                       (hub._write_checkpoint);
 #   * preemption      — raise SimulatedPreemption at hub iteration k
 #                       (hub.sync), exercising the emergency-save +
-#                       restore-from-checkpoint path end to end.
+#                       restore-from-checkpoint path end to end;
+#   * dispatch        — fault the solve-dispatch layer (ISSUE 9,
+#                       docs/dispatch.md failure semantics): hang a
+#                       megabatch dispatch, raise from it, poison a
+#                       specific submitted request (raises every time
+#                       its lanes are in the batch — the bisection
+#                       quarantine's target), drop a ticket's result
+#                       delivery, jitter the "device" with slow sleeps,
+#                       or kill the dispatcher daemon thread
+#                       (dispatch/scheduler.py seams).
 #
 # Every seam is a plain Python call on the host driver loop: NOTHING
 # enters the jitted graph, so a disarmed (or absent) plan has zero
@@ -96,6 +105,54 @@ class LaneFault:
             raise ValueError(f"unknown lane fault mode {self.mode!r}")
 
 
+class DispatchPoison(RuntimeError):
+    """Injected NaN-poisoned-batch analog: the dispatch raises whenever
+    the poisoned submit's lanes ride in the megabatch, so retry never
+    clears it and only bisection can isolate it (dispatch/scheduler.py
+    _solve_recover)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchFault:
+    """One dispatch-layer fault (host-only seams inside
+    dispatch/scheduler.py; zero jit-graph impact — the seams run on the
+    host dispatch path around `solve_fn`, never inside it).
+
+    kind: 'hang'            -> the dispatch blocks for hang_s seconds
+                               (exercises the dispatch timeout + retry)
+          'exception'       -> the dispatch raises RuntimeError
+          'slow'            -> seeded jitter sleep in [0, jitter_s]
+                               (a slow device, not a failure)
+          'poison'          -> raise DispatchPoison whenever any submit
+                               in `submits` rides in the batch — retry
+                               cannot clear it; bisection isolates and
+                               quarantines exactly those requests
+          'drop_ticket'     -> complete the solve but never deliver the
+                               result to the `submits` tickets (a lost
+                               result; the ticket deadline converts the
+                               would-be hang into a typed SolveFailed)
+          'kill_dispatcher' -> raise inside the dispatcher daemon loop
+                               (thread death; the supervisor must fail
+                               queued tickets fast, once)
+
+    at_dispatches: dispatch-attempt indices (0-based, counting every
+    attempt including retries) that hang/exception/slow fire on; empty
+    means every attempt.  submits: 0-based submit indices (the order
+    requests entered `SolveScheduler.submit`) for poison/drop_ticket.
+    """
+
+    kind: str
+    at_dispatches: tuple[int, ...] = ()
+    submits: tuple[int, ...] = ()
+    hang_s: float = 3600.0
+    jitter_s: float = 0.05
+
+    def __post_init__(self):
+        if self.kind not in ("hang", "exception", "slow", "poison",
+                             "drop_ticket", "kill_dispatcher"):
+            raise ValueError(f"unknown dispatch fault {self.kind!r}")
+
+
 @dataclasses.dataclass(frozen=True)
 class CheckpointFault:
     """Damage the `at_write`-th completed checkpoint file (0-based).
@@ -123,16 +180,20 @@ class FaultPlan:
     """
 
     def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
-                 checkpoints=(), preempt_at_iter: int | None = None):
+                 checkpoints=(), preempt_at_iter: int | None = None,
+                 dispatches=()):
         self.rng = np.random.default_rng(seed)
         self.spoke_bounds = tuple(spoke_bounds)
         self.lanes = tuple(lanes)
         self.checkpoints = tuple(checkpoints)
         self.preempt_at_iter = preempt_at_iter
+        self.dispatches = tuple(dispatches)
         self.fired: list[tuple[str, str]] = []
         self._writes = 0
         self._first_seen: dict[int, float] = {}
         self._preempted = False
+        self._dropped: set[int] = set()
+        self._killed_dispatcher = False
         # set by the hub when the plan is armed in its options: every
         # injection also lands in the telemetry stream as a
         # fault-injected event (docs/telemetry.md), so a chaos run's
@@ -157,6 +218,7 @@ class FaultPlan:
     @property
     def armed(self) -> bool:
         return bool(self.spoke_bounds or self.lanes or self.checkpoints
+                    or self.dispatches
                     or self.preempt_at_iter is not None)
 
     # -- seam: spoke harvest (hub._harvest_all) ---------------------------
@@ -235,6 +297,60 @@ class FaultPlan:
                     fh.seek(off)
                     fh.write(bytes(b ^ 0xFF for b in chunk))
             self._fire("checkpoint", f"{f.kind} write{idx} {path}")
+
+    # -- seams: dispatch layer (dispatch/scheduler.py) --------------------
+    # All three run on the host dispatch path — before_dispatch inside
+    # the (possibly worker-threaded) solve attempt, drop_ticket at
+    # result delivery, maybe_kill_dispatcher at the top of the daemon
+    # loop.  The bus is thread-safe, so _fire from these threads is
+    # safe; the seeded rng draws keep 'slow' jitter deterministic in
+    # submission order under the scheduler's lock-serialized delivery.
+    def before_dispatch(self, index: int, submit_ids) -> None:
+        """Called with the dispatch-attempt index and the submit ids of
+        every request riding this megabatch; may sleep or raise."""
+        import time as _time
+        for f in self.dispatches:
+            if f.kind == "poison":
+                hit = sorted(set(submit_ids) & set(f.submits))
+                if hit:
+                    self._fire("dispatch",
+                               f"poison submits{hit} attempt{index}")
+                    raise DispatchPoison(
+                        f"injected poison in submits {hit}")
+            elif f.kind in ("hang", "exception", "slow"):
+                if f.at_dispatches and index not in f.at_dispatches:
+                    continue
+                if f.kind == "hang":
+                    self._fire("dispatch", f"hang attempt{index}")
+                    _time.sleep(f.hang_s)
+                elif f.kind == "exception":
+                    self._fire("dispatch", f"exception attempt{index}")
+                    raise RuntimeError(
+                        f"injected dispatch exception (attempt {index})")
+                else:
+                    self._fire("dispatch", f"slow attempt{index}")
+                    _time.sleep(float(self.rng.uniform(0.0, f.jitter_s)))
+
+    def drop_ticket(self, submit_id: int) -> bool:
+        """True when this submit's completed result must be withheld
+        from its ticket (a lost delivery; fires once per submit)."""
+        for f in self.dispatches:
+            if f.kind == "drop_ticket" and submit_id in f.submits \
+                    and submit_id not in self._dropped:
+                self._dropped.add(submit_id)
+                self._fire("dispatch", f"drop_ticket submit{submit_id}")
+                return True
+        return False
+
+    def maybe_kill_dispatcher(self) -> None:
+        """Raise inside the dispatcher daemon loop, once."""
+        if self._killed_dispatcher:
+            return
+        for f in self.dispatches:
+            if f.kind == "kill_dispatcher":
+                self._killed_dispatcher = True
+                self._fire("dispatch", "kill_dispatcher")
+                raise RuntimeError("injected dispatcher-thread death")
 
     # -- seam: preemption (hub.sync) --------------------------------------
     def maybe_preempt(self, hub_iter: int) -> None:

@@ -108,6 +108,15 @@ class WheelSpinner:
         propagating whatever happens here."""
         detail = f"{type(exc).__name__}: {exc}"
         try:
+            # the wheel is dying on an explicit exception, not a hang:
+            # the progress watchdog must not also trip (and abort the
+            # process out from under the caller's unwind)
+            wd = getattr(self.spcomm, "_watchdog", None)
+            if wd is not None:
+                wd.stop()
+        except Exception:
+            pass
+        try:
             self.spcomm.emit_run_end(reason, error=detail)
         except Exception:
             pass

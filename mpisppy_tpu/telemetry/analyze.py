@@ -98,6 +98,9 @@ class RunModel:
     ckpt_restores: list = dataclasses.field(default_factory=list)
     megabatches: list = dataclasses.field(default_factory=list)
     dispatch_stats: list = dataclasses.field(default_factory=list)
+    dispatch_retries: list = dataclasses.field(default_factory=list)
+    dispatch_quarantines: list = dataclasses.field(default_factory=list)
+    watchdogs: list = dataclasses.field(default_factory=list)
     kernel: dict = dataclasses.field(default_factory=dict)  # cyl -> last
     spoke_classes: dict = dataclasses.field(default_factory=dict)
     profiles: list = dataclasses.field(default_factory=list)  # profile evs
@@ -189,6 +192,12 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
                 m.megabatches.append({"iter": it, **data})
             else:
                 m.dispatch_stats.append({"iter": it, **data})
+        elif kind == ev.DISPATCH_RETRY:
+            m.dispatch_retries.append({"iter": it, **data})
+        elif kind == ev.DISPATCH_QUARANTINE:
+            m.dispatch_quarantines.append({"iter": it, **data})
+        elif kind == ev.WATCHDOG:
+            m.watchdogs.append({"iter": it, **data})
         elif kind == ev.KERNEL_COUNTERS:
             m.kernel["hub" if r.get("cyl") in (None, "", "hub")
                      else r["cyl"]] = data
@@ -348,6 +357,24 @@ def _dispatch_audit(model: RunModel) -> dict | None:
             "coalesced": sum(1 for b in mbs if b.get("requests", 1) > 1),
             "pre_wheel": sum(1 for b in mbs if (b.get("iter") or 0) < 0),
         })
+        # occupancy attribution by dispatch cause (ISSUE 9 satellite):
+        # a timer-heavy mix means windows expire before filling — the
+        # occupancy loss is admission-deadline driven, not size driven
+        by_cause: dict[str, dict] = {}
+        for b in mbs:
+            c = b.get("cause")
+            if c is None:
+                continue
+            a = by_cause.setdefault(c, {"batches": 0, "lanes": 0,
+                                        "padded": 0})
+            a["batches"] += 1
+            a["lanes"] += b.get("lanes", 0)
+            a["padded"] += b.get("padded_to", 0)
+        for a in by_cause.values():
+            a["occupancy"] = (round(a["lanes"] / a["padded"], 4)
+                              if a["padded"] else None)
+        if by_cause:
+            out["by_cause"] = by_cause
     if model.dispatch_stats:
         last = model.dispatch_stats[-1]
         out.update({
@@ -356,6 +383,9 @@ def _dispatch_audit(model: RunModel) -> dict | None:
             "backend_compiles": last.get("backend_compiles"),
             "unexpected_recompiles": last.get("unexpected_recompiles"),
             "inflight_max": last.get("inflight_max"),
+            "retries_total": last.get("retries_total"),
+            "quarantined_lanes": last.get("quarantined_lanes"),
+            "degraded": last.get("degraded"),
         })
         # compile-cache discipline: in steady state each shape bucket
         # compiles once; more compiles than buckets means the ladder is
@@ -381,6 +411,15 @@ def _resilience_summary(model: RunModel) -> dict:
         "checkpoint_restores": len(model.ckpt_restores),
         "restore_fallbacks": sum(1 for c in model.ckpt_restores
                                  if c.get("fallback")),
+        # dispatch fault domain (ISSUE 9; docs/dispatch.md)
+        "dispatch_retries": len(model.dispatch_retries),
+        "dispatch_quarantined_lanes": sum(
+            q.get("lanes", 0) for q in model.dispatch_quarantines),
+        "dispatch_quarantined_requests": len(model.dispatch_quarantines),
+        "watchdog_trips": sum(1 for w in model.watchdogs
+                              if w.get("action") in ("abort", "degrade")),
+        "dispatcher_deaths": sum(1 for w in model.watchdogs
+                                 if w.get("component") == "dispatcher"),
     }
 
 
@@ -446,6 +485,18 @@ def analyze(model: RunModel) -> dict:
     if rep["resilience"]["bound_evictions"]:
         flags.append(f"{rep['resilience']['bound_evictions']} incumbent "
                      "bound eviction(s)")
+    if rep["resilience"]["dispatch_quarantined_lanes"]:
+        flags.append(
+            f"{rep['resilience']['dispatch_quarantined_lanes']} dispatch "
+            f"lane(s) quarantined "
+            f"({rep['resilience']['dispatch_quarantined_requests']} "
+            "request(s) resolved SolveFailed)")
+    if rep["resilience"]["watchdog_trips"]:
+        flags.append(f"watchdog tripped "
+                     f"{rep['resilience']['watchdog_trips']} time(s)")
+    if rep["resilience"]["dispatcher_deaths"]:
+        flags.append(f"{rep['resilience']['dispatcher_deaths']} "
+                     "dispatcher-thread death(s) (tickets failed fast)")
     rep["flags"] = flags
     return rep
 
@@ -597,6 +648,15 @@ def render_report(rep: dict) -> str:
                  f"quarantine resets {res['lane_quarantine_resets']}  "
                  f"ckpt writes/restores {res['checkpoint_writes']}"
                  f"/{res['checkpoint_restores']}")
+        if res.get("dispatch_retries") or res.get(
+                "dispatch_quarantined_lanes") or res.get(
+                "watchdog_trips") or res.get("dispatcher_deaths"):
+            L.append(f"  dispatch fault domain: retries "
+                     f"{res['dispatch_retries']}  quarantined lanes "
+                     f"{res['dispatch_quarantined_lanes']} "
+                     f"({res['dispatch_quarantined_requests']} requests)"
+                     f"  watchdog trips {res['watchdog_trips']}"
+                     f"  dispatcher deaths {res['dispatcher_deaths']}")
     for cyl, k in rep["kernel"].items():
         tot = k.get("pdhg_iterations_total")
         if tot is not None:

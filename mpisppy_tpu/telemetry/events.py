@@ -32,6 +32,13 @@ CHECKPOINT_RESTORE = "checkpoint-restore"
 FAULT_INJECTED = "fault-injected"      # a FaultPlan seam fired
 LANE_QUARANTINE = "lane-quarantine"    # PDHG lane guard reset lanes
 DISPATCH = "dispatch"                  # one coalesced megabatch dispatched
+DISPATCH_RETRY = "dispatch-retry"      # a failed/hung dispatch re-tried
+DISPATCH_QUARANTINE = "dispatch-quarantine"  # a poisoned request isolated
+                                       # by bisection; its ticket resolves
+                                       # with a typed SolveFailed
+WATCHDOG = "watchdog"                  # a supervisor tripped / acted
+                                       # (hub progress stall, dispatcher
+                                       # thread death)
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler lifecycle: "start", or

@@ -48,6 +48,14 @@ GATES: tuple[tuple[str, str, float], ...] = (
     (r"unexpected_recompiles", "up", 0.0),
     (r"guard_resets", "up", 0.0),
     (r"(^|\.)final_rel_gap$", "up", 0.25),
+    # dispatch fault domain (ISSUE 9): on the committed bench fixtures
+    # any retry growth or quarantined lane is a regression — the bench
+    # workloads are fault-free by construction, so these counters only
+    # move when the dispatch layer itself started failing
+    (r"(retries_total|dispatch_retries)$", "up", 0.0),
+    (r"quarantined_lanes", "up", 0.0),
+    (r"quarantined_requests", "up", 0.0),
+    (r"(watchdog_trips|dispatcher_deaths)", "up", 0.0),
     # device-trace roofline metrics (telemetry/roofline.py, ISSUE 7):
     # bandwidth, DMA/compute overlap and MFU falling is a regression;
     # device time per iteration rising is one.  Together with the
@@ -222,6 +230,13 @@ def extract_metrics(obj: dict) -> dict[str, float]:
             if isinstance(hit, dict) and hit.get("seconds") is not None:
                 out[f"time_to_gap.{tgt}"] = float(hit["seconds"])
         _flatten("dispatch", obj.get("dispatch") or {}, out)
+        res = obj.get("resilience") or {}
+        for k in ("dispatch_retries", "dispatch_quarantined_lanes",
+                  "dispatch_quarantined_requests", "watchdog_trips",
+                  "dispatcher_deaths", "lane_quarantine_resets"):
+            if isinstance(res.get(k), (int, float)) \
+                    and not isinstance(res.get(k), bool):
+                out[f"resilience.{k}"] = float(res[k])
         for cyl, k in (obj.get("kernel") or {}).items():
             if isinstance(k, dict) \
                     and k.get("pdhg_guard_resets_total") is not None:

@@ -43,6 +43,9 @@ class WatchState:
         self.strikes = 0
         self.disables = 0
         self.faults = 0
+        self.dispatch_retries = 0
+        self.dispatch_quarantined = 0
+        self.watchdog_trips = 0
         self.ckpt_writes = 0
         self.last_ckpt_wall = None
         self.last_event_wall = None
@@ -82,6 +85,16 @@ class WatchState:
                 self.dispatch_last = data
         elif kind == "lane-quarantine":
             self.quarantine_resets += data.get("resets", 0)
+        elif kind == "dispatch-retry":
+            self.dispatch_retries += 1
+        elif kind == "dispatch-quarantine":
+            self.dispatch_quarantined += data.get("lanes", 0)
+        elif kind == "watchdog":
+            # count hub-watchdog TRIPS only, mirroring the analyzer's
+            # resilience summary — a dispatcher fail-fast event shares
+            # the kind but is not a progress-watchdog trip
+            if data.get("action") in ("abort", "degrade"):
+                self.watchdog_trips += 1
         elif kind == "spoke-strike":
             self.strikes += 1
         elif kind == "spoke-disable":
@@ -184,6 +197,9 @@ def render_status(state: WatchState,
     L.append(f"resilience: quarantine resets {state.quarantine_resets}"
              f"  strikes {state.strikes}  disabled {state.disables}"
              f"  faults {state.faults}"
+             f"  retries {state.dispatch_retries}"
+             f"  quarantined lanes {state.dispatch_quarantined}"
+             f"  watchdog {state.watchdog_trips}"
              f"  ckpt writes {state.ckpt_writes}"
              + (f" (last {ck_age:.0f}s ago)" if ck_age is not None
                 else ""))

@@ -48,7 +48,9 @@ def _hub_opts(cfg) -> dict:
         hub_opts["max_stalled_iters"] = cfg["max_stalled_iters"]
     for key in ("checkpoint_path", "checkpoint_every_s",
                 "checkpoint_keep", "spoke_max_strikes", "bound_slack",
-                "bound_evict_contras", "profile_dir", "profile_iters"):
+                "bound_evict_contras", "profile_dir", "profile_iters",
+                "watchdog_budget_s", "watchdog_action",
+                "watchdog_interval_s"):
         if cfg.get(key) is not None:
             hub_opts[key] = cfg[key]
     return hub_opts

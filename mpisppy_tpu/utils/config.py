@@ -395,6 +395,20 @@ class Config:
         self.add_to_config("guard_max_resets",
                            "bounded quarantine retries per PDHG lane",
                            int, 3)
+        self.add_to_config("watchdog_budget_s",
+                           "hub progress watchdog: trip when no hub "
+                           "iteration or certified-bound movement for "
+                           "this many wall seconds (off when unset)",
+                           float, None)
+        self.add_to_config("watchdog_action",
+                           "watchdog trip action: 'abort' (flight dump "
+                           "+ emergency checkpoint + exit 75) or "
+                           "'degrade' (un-coalesced direct dispatch; "
+                           "a second stalled budget escalates to "
+                           "abort)", str, "abort")
+        self.add_to_config("watchdog_interval_s",
+                           "watchdog poll interval (default: a quarter "
+                           "of the budget)", float, None)
 
     def telemetry_args(self):
         """Telemetry subsystem knobs (docs/telemetry.md): structured
@@ -466,6 +480,23 @@ class Config:
         self.add_to_config("dispatch_compile_guard",
                            "raise on a backend compile against an "
                            "already-warm shape bucket", bool, False)
+        self.add_to_config("dispatch_timeout_s",
+                           "per-attempt megabatch dispatch timeout: a "
+                           "hung dispatch is abandoned and retried "
+                           "after this many seconds (off when unset)",
+                           float, None)
+        self.add_to_config("dispatch_retry_max",
+                           "retries (with exponential backoff) before "
+                           "a failing megabatch is bisected to isolate "
+                           "and quarantine the poison request(s)",
+                           int, 2)
+        self.add_to_config("dispatch_retry_backoff_s",
+                           "base retry backoff, doubled per retry",
+                           float, 0.05)
+        self.add_to_config("dispatch_deadline_s",
+                           "default per-ticket deadline: result() can "
+                           "never block longer; expiry raises a typed "
+                           "SolveFailed (off when unset)", float, None)
 
     def checker(self):
         """Cross-option validation (ref:config.py:143-157)."""

@@ -10,6 +10,7 @@ import dataclasses
 import math
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -542,6 +543,135 @@ def test_spoke_auto_disable_wheel_continues():
     assert ws.BestOuterBound <= ws.BestInnerBound + 2e-3 * abs(
         ws.BestInnerBound)
     assert ws.spcomm.latest_ob_char == "T"
+
+
+# ---------------------------------------------------------------------------
+# Hub progress watchdog (resilience/watchdog.py; ISSUE 9): stalls trip a
+# flight dump + the configured action — checkpoint-and-abort exit 75, or
+# dispatch degradation with escalation on a second stalled budget.
+# ---------------------------------------------------------------------------
+class _WatchdogHub:
+    """Duck-typed hub for watchdog unit tests."""
+
+    def __init__(self, bus=None, ckpt_path=None):
+        from mpisppy_tpu import telemetry
+        self.telemetry = bus or telemetry.EventBus()
+        self.run_id = "wdtest"
+        self.options = {"checkpoint_path": ckpt_path}
+        self.saved = []
+
+    def emergency_checkpoint(self, path):
+        self.saved.append(path)
+        return True
+
+
+def test_watchdog_trips_abort_with_checkpoint_and_exit75(tmp_path):
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.resilience import HubWatchdog
+
+    seen = []
+
+    class _Probe:
+        def handle(self, ev):
+            seen.append(ev)
+
+    bus = telemetry.EventBus()
+    bus.subscribe(_Probe())
+    rec = telemetry.FlightRecorder(capacity=16, dump_dir=str(tmp_path))
+    bus.subscribe(rec)
+    hub = _WatchdogHub(bus, ckpt_path=str(tmp_path / "w.npz"))
+    codes = []
+    wd = HubWatchdog(hub, budget_s=0.15, action="abort",
+                     interval_s=0.02, abort_fn=codes.append).start()
+    wd.beat(1, -100.0, -90.0)
+    deadline = time.perf_counter() + 5.0
+    while not codes and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert codes == [75], "watchdog never aborted (or wrong exit code)"
+    assert hub.saved == [str(tmp_path / "w.npz")]  # last-gasp save ran
+    events = [e for e in seen if e.kind == "watchdog"]
+    assert events and events[0].data["action"] == "abort"
+    assert events[0].data["stalled_s"] >= 0.15
+    assert rec.dumped_to, "no flight-recorder black box on the trip"
+    from mpisppy_tpu.telemetry import metrics as metrics_mod
+    assert metrics_mod.REGISTRY.get("watchdog_trips_total") >= 1
+
+
+def test_watchdog_beats_hold_off_the_trip():
+    from mpisppy_tpu.resilience import HubWatchdog
+    hub = _WatchdogHub()
+    codes = []
+    wd = HubWatchdog(hub, budget_s=0.2, action="abort",
+                     interval_s=0.02, abort_fn=codes.append).start()
+    t_end = time.perf_counter() + 0.6
+    it = 0
+    while time.perf_counter() < t_end:   # steady progress: 3x budget
+        it += 1
+        wd.beat(it, -100.0 - it, -90.0)
+        time.sleep(0.02)
+    wd.stop()
+    assert codes == [] and wd.trips == 0
+
+
+def test_watchdog_degrade_then_escalate(tmp_path):
+    from mpisppy_tpu import dispatch
+    from mpisppy_tpu.resilience import HubWatchdog
+
+    sched = dispatch.configure()
+    try:
+        assert sched.options.coalesce
+        hub = _WatchdogHub()
+        codes = []
+        wd = HubWatchdog(hub, budget_s=0.1, action="degrade",
+                         interval_s=0.02, abort_fn=codes.append)
+        wd.start()
+        deadline = time.perf_counter() + 5.0
+        while not sched.stats()["degraded"] \
+                and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert sched.stats()["degraded"], "degrade action never reached " \
+            "the process-default scheduler"
+        assert not sched.options.coalesce
+        # a SECOND stalled budget escalates to the abort action
+        deadline = time.perf_counter() + 5.0
+        while not codes and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert codes == [75] and wd.trips >= 2
+    finally:
+        dispatch.configure()
+
+
+def test_watchdog_wired_from_hub_options_and_stopped_at_finalize():
+    """--watchdog-budget-s reaches the hub: the wheel arms a watchdog,
+    beats it every sync, and finalize stops it — a healthy short run
+    never trips."""
+    batch = farmer_batch(3)
+    ws = WheelSpinner(
+        hub_dict(batch, {"watchdog_budget_s": 300.0,
+                         "watchdog_action": "degrade"},
+                 max_iterations=3),
+        [dict(d) for d in BOTH_SPOKES]).spin()
+    wd = ws.spcomm._watchdog
+    assert wd is not None
+    assert wd.trips == 0 and not wd.degraded
+    assert wd._stop.is_set(), "finalize did not stop the watchdog"
+
+
+def test_watchdog_cli_knobs_reach_hub_options():
+    from mpisppy_tpu.utils import cfg_vanilla as vanilla
+    from mpisppy_tpu.utils.config import Config
+    cfg = Config()
+    cfg.popular_args()
+    cfg.resilience_args()
+    cfg.parse_command_line("t", [
+        "--watchdog-budget-s", "120", "--watchdog-action", "degrade",
+        "--watchdog-interval-s", "5"])
+    opts = vanilla._hub_opts(cfg)
+    assert opts["watchdog_budget_s"] == 120.0
+    assert opts["watchdog_action"] == "degrade"
+    assert opts["watchdog_interval_s"] == 5.0
 
 
 @pytest.mark.slow

@@ -13,9 +13,11 @@ import jax.numpy as jnp
 
 from mpisppy_tpu import dispatch
 from mpisppy_tpu.dispatch import (
-    BucketLadder, CompileWatch, DispatchOptions, SolveScheduler,
-    pad_qp_batch, slice_result,
+    BucketLadder, CompileWatch, DispatchOptions, SolveFailed,
+    SolveScheduler, pad_qp_batch, slice_result,
 )
+from mpisppy_tpu.dispatch.buckets import balanced_split
+from mpisppy_tpu.resilience import DispatchFault, FaultPlan
 from mpisppy_tpu.ops import bnb
 from mpisppy_tpu.ops.bnb import BnBOptions, BnBResult
 
@@ -433,6 +435,230 @@ def test_warm_start_kwargs_ride_the_padding():
     assert res.inner.shape == (5,)
 
 
+# -- fault domain: deadlines, retry, bisection quarantine, supervisor ------
+# (ISSUE 9; docs/dispatch.md failure semantics — a solve_mip caller
+# observes a result or a typed SolveFailed, never a hang)
+def test_balanced_split_halves_lanes():
+    assert balanced_split([3, 3, 3]) == 1            # 3 | 6 vs 6 | 3: tie -> first
+    assert balanced_split([1, 1, 8]) == 2            # big request isolated
+    assert balanced_split([8, 1, 1]) == 1
+    with pytest.raises(ValueError):
+        balanced_split([4])
+
+
+def test_ticket_result_timeout_kwarg_never_hangs():
+    """Satellite: result(timeout=) bounds the wait — expiry raises a
+    typed SolveFailed('deadline'); a later call returns the result once
+    the (slow) dispatch eventually lands."""
+    def slow(qp, d, ic, o, **kw):
+        time.sleep(0.3)
+        return _fake_result(qp)
+
+    sched = SolveScheduler(DispatchOptions(max_wait_ms=1.0),
+                           solve_fn=slow)
+    qp, _, _ = random_mips(S=3)
+    t = sched.submit(qp, _d(qp), np.arange(2, dtype=np.int32), LEAN)
+    t0 = time.perf_counter()
+    with pytest.raises(SolveFailed) as ei:
+        t.result(timeout=0.05)
+    assert ei.value.reason == "deadline"
+    assert time.perf_counter() - t0 < 0.25, "blocked past the timeout"
+    res = t.result()                       # the solve still lands
+    assert np.allclose(np.asarray(res.inner),
+                       np.asarray(qp.c).sum(-1))
+
+
+def test_submit_deadline_bounds_every_result_call():
+    """Tentpole: a per-ticket deadline (submit deadline_s / the
+    options default) bounds result() even with NO timeout argument."""
+    def hang(qp, d, ic, o, **kw):
+        time.sleep(5.0)
+        return _fake_result(qp)
+
+    sched = SolveScheduler(DispatchOptions(max_wait_ms=1.0,
+                                           deadline_s=0.08),
+                           solve_fn=hang)
+    qp, _, _ = random_mips(S=3)
+    t = sched.submit(qp, _d(qp), np.arange(2, dtype=np.int32), LEAN)
+    t0 = time.perf_counter()
+    with pytest.raises(SolveFailed) as ei:
+        t.result()
+    assert ei.value.reason == "deadline"
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_hung_dispatch_times_out_and_retry_succeeds():
+    """A hung dispatch is abandoned after dispatch_timeout_s and
+    retried with backoff; the retry lands and the caller sees a normal
+    result plus a retries_total count."""
+    calls = []
+
+    def flaky(qp, d, ic, o, **kw):
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(5.0)           # first attempt hangs
+        return _fake_result(qp)
+
+    sched = SolveScheduler(
+        DispatchOptions(dispatch_timeout_s=0.1, retry_max=2,
+                        retry_backoff_s=0.01),
+        solve_fn=flaky)
+    qp, _, _ = random_mips(S=3)
+    res = sched.solve_mip(qp, _d(qp), np.arange(2, dtype=np.int32), LEAN)
+    assert np.allclose(np.asarray(res.inner),
+                       np.asarray(qp.c).sum(-1))
+    st = sched.stats()
+    assert st["retries_total"] == 1
+    assert st["quarantined_lanes"] == 0
+
+
+def test_poison_request_bisected_and_quarantined():
+    """The acceptance path: one poisoned request in a coalesced
+    megabatch fails every retry, bisection isolates it, ITS ticket
+    resolves SolveFailed and the healthy requests get correct
+    results — with the quarantined lanes accounted."""
+    from mpisppy_tpu import telemetry as tel
+    seen = []
+
+    class _Probe:
+        def handle(self, ev):
+            seen.append(ev)
+
+    bus = tel.EventBus()
+    bus.subscribe(_Probe())
+    plan = FaultPlan(seed=0, dispatches=(
+        DispatchFault("poison", submits=(1,)),))
+    sched = SolveScheduler(
+        DispatchOptions(max_wait_ms=500.0, retry_max=1,
+                        retry_backoff_s=0.001),
+        solve_fn=lambda qp, d, ic, o, **kw: _fake_result(qp),
+        fault_plan=plan, bus=bus)
+    qps = [random_mips(S=3, seed=s)[0] for s in range(3)]
+    ic = np.arange(2, dtype=np.int32)
+    d = _d(qps[0])
+    tickets = [sched.submit(qp, d, ic, LEAN) for qp in qps]
+    for k in (0, 2):
+        got = np.asarray(tickets[k].result().inner)
+        assert np.allclose(got, np.asarray(qps[k].c).sum(-1)), \
+            "healthy request got foreign lanes after bisection"
+    with pytest.raises(SolveFailed) as ei:
+        tickets[1].result()
+    assert ei.value.reason == "exception"
+    assert ei.value.lanes == 3
+    assert "DispatchPoison" in ei.value.detail
+    st = sched.stats()
+    assert st["quarantined_lanes"] == 3
+    assert st["quarantined_requests"] == 1
+    assert st["retries_total"] >= 1
+    q = [e for e in seen if e.kind == tel.DISPATCH_QUARANTINE]
+    assert len(q) == 1 and q[0].data["submit"] == 1 \
+        and q[0].data["bisected"]
+    assert [e for e in seen if e.kind == tel.DISPATCH_RETRY]
+    from mpisppy_tpu.telemetry import metrics as metrics_mod
+    assert metrics_mod.REGISTRY.get(
+        "dispatch_quarantined_lanes_total") >= 3
+
+
+def test_dispatcher_death_fails_queued_tickets_fast():
+    """Satellite + tentpole: the dispatcher daemon dying must fail
+    every queued ticket with SolveFailed('dispatcher-died') promptly —
+    not leave them hanging — and the next submit restarts the daemon."""
+    plan = FaultPlan(seed=0, dispatches=(
+        DispatchFault("kill_dispatcher"),))
+    sched = SolveScheduler(DispatchOptions(max_wait_ms=20.0),
+                           solve_fn=lambda qp, d, ic, o, **kw:
+                           _fake_result(qp),
+                           fault_plan=plan)
+    qp, _, _ = random_mips(S=3)
+    t = sched.submit(qp, _d(qp), np.arange(2, dtype=np.int32), LEAN)
+    deadline = time.perf_counter() + 5.0
+    while not t.done() and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    assert t.done(), "queued ticket hung on a dead dispatcher"
+    with pytest.raises(SolveFailed) as ei:
+        t.result()
+    assert ei.value.reason == "dispatcher-died"
+    assert sched.stats()["dispatcher_deaths"] == 1
+    # the kill fired once; a fresh submit restarts the daemon and works
+    t2 = sched.submit(qp, _d(qp), np.arange(2, dtype=np.int32), LEAN)
+    assert np.asarray(t2.result().inner).shape == (3,)
+
+
+def test_exception_raising_dispatch_propagates_to_all_window_tickets():
+    """Satellite: a dispatch raising on ANOTHER thread must propagate
+    to every ticket in the window (here: retries exhausted on a window
+    driven by the admission-timer daemon)."""
+    def bad(qp, d, ic, o, **kw):
+        raise RuntimeError("synthetic device failure")
+
+    sched = SolveScheduler(
+        DispatchOptions(max_wait_ms=10.0, retry_max=1,
+                        retry_backoff_s=0.001),
+        solve_fn=bad)
+    qp, _, _ = random_mips(S=3)
+    d = _d(qp)
+    ic = np.arange(2, dtype=np.int32)
+    t1 = sched.submit(qp, d, ic, LEAN)
+    t2 = sched.submit(qp, d, ic, LEAN)
+    deadline = time.perf_counter() + 5.0
+    while not (t1.done() and t2.done()) \
+            and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    for t in (t1, t2):
+        with pytest.raises(SolveFailed) as ei:
+            t.result(timeout=1.0)
+        assert ei.value.reason == "exception"
+        assert "synthetic device failure" in ei.value.detail
+
+
+def test_stats_split_dispatch_cause():
+    """Satellite: stats() attributes every dispatch to why it fired —
+    admission-timer expiry vs size overflow vs a blocking caller — so
+    the analyzer can attribute occupancy loss to timeouts."""
+    sched = SolveScheduler(
+        DispatchOptions(max_batch=6, max_wait_ms=30.0),
+        solve_fn=lambda qp, d, ic, o, **kw: _fake_result(qp))
+    ic = np.arange(2, dtype=np.int32)
+    # size: two 3-lane submits fill max_batch exactly
+    qa, qb = (random_mips(S=3, seed=s)[0] for s in (0, 1))
+    d = _d(qa)
+    ta = sched.submit(qa, d, ic, LEAN)
+    tb = sched.submit(qb, d, ic, LEAN)
+    ta.result(), tb.result()
+    # inline: a lone blocking caller drives its own window
+    qc = random_mips(S=2, seed=2)[0]
+    sched.solve_mip(qc, _d(qc), ic, LEAN)
+    # timer: a fire-and-forget submit waits out the admission window
+    qd = random_mips(S=2, seed=3)[0]
+    td = sched.submit(qd, _d(qd), ic, LEAN)
+    deadline = time.perf_counter() + 5.0
+    while not td.done() and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    by = sched.stats()["by_cause"]
+    assert by.get("size") == 1, by
+    assert by.get("inline") == 1, by
+    assert by.get("timer") == 1, by
+    assert sched.stats()["batches"] == sum(by.values())
+
+
+def test_degrade_switches_to_uncoalesced_direct_dispatch():
+    sched = SolveScheduler(
+        DispatchOptions(max_wait_ms=500.0),
+        solve_fn=lambda qp, d, ic, o, **kw: _fake_result(qp))
+    assert sched.options.coalesce
+    sched.degrade()
+    assert not sched.options.coalesce
+    assert sched.stats()["degraded"]
+    # still solves, one window per submit
+    qp, _, _ = random_mips(S=3)
+    ic = np.arange(2, dtype=np.int32)
+    d = _d(qp)
+    t1 = sched.submit(qp, d, ic, LEAN)
+    t2 = sched.submit(qp, d, ic, LEAN)
+    t1.result(), t2.result()
+    assert sched.stats()["batches"] == 2
+
+
 def test_dispatch_cli_knobs_and_from_cfg():
     from mpisppy_tpu.utils.config import Config
 
@@ -441,7 +667,10 @@ def test_dispatch_cli_knobs_and_from_cfg():
     cfg.parse_command_line("t", [
         "--dispatch-max-inflight", "3", "--dispatch-max-batch", "64",
         "--dispatch-coalesce", "false", "--dispatch-bucket-growth",
-        "1.5", "--dispatch-compile-guard"])
+        "1.5", "--dispatch-compile-guard",
+        "--dispatch-timeout-s", "30", "--dispatch-retry-max", "4",
+        "--dispatch-retry-backoff-s", "0.2",
+        "--dispatch-deadline-s", "120"])
     try:
         sched = dispatch.from_cfg(cfg)
         assert sched is dispatch.get_scheduler()
@@ -449,6 +678,8 @@ def test_dispatch_cli_knobs_and_from_cfg():
         assert o.max_inflight == 3 and o.max_batch == 64
         assert o.coalesce is False and o.compile_guard is True
         assert sched.ladder.growth == 1.5
+        assert o.dispatch_timeout_s == 30.0 and o.retry_max == 4
+        assert o.retry_backoff_s == 0.2 and o.deadline_s == 120.0
     finally:
         # restore the process default for whatever test runs next
         dispatch.configure()

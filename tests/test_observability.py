@@ -521,3 +521,176 @@ def test_readme_claims_lint_catches_drift(tmp_path):
         "Out of scope: nothing.\n")
     vio3 = tool.find_violations(readme=str(fake), pool={999.0})
     assert len(vio3) == 1 and "precision" in vio3[0]
+
+
+# ---------------------------------------------------------------------------
+# Dispatch fault domain observability (ISSUE 9): analyzer resilience
+# rows + dispatch-cause audit, regress-gate rows, and the `telemetry
+# watch` torn-tail contract.
+# ---------------------------------------------------------------------------
+def _fault_domain_trace(path: str) -> None:
+    """Synthesize a serving-shaped trace through the real bus+sink so
+    the row schema can never drift from the emitters'."""
+    bus = telemetry.EventBus()
+    bus.subscribe(telemetry.JsonlSink(path))
+    run = "fdrun"
+    bus.emit(telemetry.RUN_START, run=run, cyl="hub",
+             hub_class="PHHub", num_spokes=2)
+    for it in (1, 2, 3):
+        bus.emit(telemetry.HUB_ITERATION, run=run, cyl="hub",
+                 hub_iter=it, iter=it, outer=-110.0 - it, inner=-100.0,
+                 abs_gap=10.0, rel_gap=0.1)
+    bus.emit(telemetry.DISPATCH, run=run, cyl="dispatch", hub_iter=1,
+             requests=2, lanes=6, padded_to=8, occupancy=0.75,
+             bucket=[8, 4, 4], wait_ms=1.0, queue_depth=0,
+             cause="timer", inflight_max=1)
+    bus.emit(telemetry.DISPATCH, run=run, cyl="dispatch", hub_iter=2,
+             requests=1, lanes=8, padded_to=8, occupancy=1.0,
+             bucket=[8, 4, 4], wait_ms=0.1, queue_depth=0,
+             cause="size", inflight_max=1)
+    bus.emit(telemetry.DISPATCH_RETRY, run=run, cyl="dispatch",
+             hub_iter=2, attempt=1, requests=2, lanes=6,
+             backoff_s=0.05, error="RuntimeError: injected")
+    bus.emit(telemetry.DISPATCH_QUARANTINE, run=run, cyl="dispatch",
+             hub_iter=2, submit=3, lanes=3, attempts=4,
+             reason="exception", bisected=True,
+             error="DispatchPoison: injected")
+    bus.emit(telemetry.WATCHDOG, run=run, cyl="watchdog",
+             component="hub", action="degrade", stalled_s=12.5,
+             budget_s=10.0, trips=1)
+    bus.emit(telemetry.WATCHDOG, run=run, cyl="dispatch",
+             component="dispatcher", action="fail-fast",
+             failed_tickets=2, error="RuntimeError: killed")
+    bus.emit(telemetry.DISPATCH, run=run, cyl="hub", hub_iter=3,
+             batches=3, buckets=2, backend_compiles=2,
+             unexpected_recompiles=0, inflight_max=1,
+             retries_total=1, quarantined_lanes=3, degraded=True)
+    bus.emit(telemetry.RUN_END, run=run, cyl="hub", hub_iter=3,
+             reason="max-iter", outer=-113.0, inner=-100.0,
+             abs_gap=13.0, rel_gap=0.13, iterations=3)
+    bus.close()
+
+
+def test_analyzer_reports_dispatch_fault_domain(tmp_path):
+    path = str(tmp_path / "fd.jsonl")
+    _fault_domain_trace(path)
+    rep = an.analyze_path(path)
+    res = rep["resilience"]
+    assert res["dispatch_retries"] == 1
+    assert res["dispatch_quarantined_lanes"] == 3
+    assert res["dispatch_quarantined_requests"] == 1
+    assert res["watchdog_trips"] == 1          # degrade counts, fail-
+    assert res["dispatcher_deaths"] == 1       # fast is its own row
+    d = rep["dispatch"]
+    # the cause split attributes occupancy loss to admission timeouts
+    assert d["by_cause"]["timer"]["batches"] == 1
+    assert d["by_cause"]["timer"]["occupancy"] == 0.75
+    assert d["by_cause"]["size"]["occupancy"] == 1.0
+    assert d["retries_total"] == 1 and d["quarantined_lanes"] == 3
+    flags = "\n".join(rep["flags"])
+    assert "quarantined" in flags and "watchdog" in flags \
+        and "dispatcher-thread death" in flags
+    text = an.render_report(rep)
+    assert "dispatch fault domain: retries 1" in text
+    json.dumps(rep)
+
+
+def test_gate_fails_on_quarantine_or_retry_increase(tmp_path):
+    """ISSUE 9 regress rows: on bench-style artifacts ANY increase in
+    dispatch retries or quarantined lanes is a regression."""
+    old = {"phase": {"seconds_to_gap": 100.0,
+                     "dispatch": {"batches": 5, "retries_total": 0,
+                                  "quarantined_lanes": 0}}}
+    good = {"phase": {"seconds_to_gap": 101.0,
+                      "dispatch": {"batches": 5, "retries_total": 0,
+                                   "quarantined_lanes": 0}}}
+    bad_q = {"phase": {"seconds_to_gap": 101.0,
+                       "dispatch": {"batches": 5, "retries_total": 0,
+                                    "quarantined_lanes": 3}}}
+    bad_r = {"phase": {"seconds_to_gap": 101.0,
+                       "dispatch": {"batches": 5, "retries_total": 2,
+                                    "quarantined_lanes": 0}}}
+    assert regress.gate(old, good)["ok"]
+    repq = regress.gate(old, bad_q)
+    assert not repq["ok"]
+    assert any("quarantined_lanes" in r["metric"]
+               for r in repq["regressions"])
+    repr_ = regress.gate(old, bad_r)
+    assert not repr_["ok"]
+    assert any("retries_total" in r["metric"]
+               for r in repr_["regressions"])
+
+
+def test_gate_analyzer_resilience_rows(tmp_path):
+    """Analyzer reports carry the fault-domain counters into the gate:
+    a run that started quarantining lanes fails against a clean one."""
+    clean = str(tmp_path / "clean.jsonl")
+    bus = telemetry.EventBus()
+    bus.subscribe(telemetry.JsonlSink(clean))
+    farmer_wheel(bus, max_iterations=4)
+    bus.close()
+    rep_old = an.analyze_path(clean)
+    faulty = str(tmp_path / "faulty.jsonl")
+    _fault_domain_trace(faulty)
+    rep_new = an.analyze_path(faulty)
+    verdict = regress.gate(rep_old, rep_new)
+    assert not verdict["ok"]
+    failing = {r["metric"] for r in verdict["regressions"]}
+    assert "resilience.dispatch_quarantined_lanes" in failing
+    assert "resilience.dispatch_retries" in failing
+    assert "resilience.watchdog_trips" in failing
+
+
+def test_watch_survives_torn_and_concurrently_appended_trace(tmp_path):
+    """Satellite: `telemetry watch` tails a trace a live wheel is
+    appending to — a torn final line (no newline / half a JSON object)
+    must not crash the tailer, must not be double-counted, and must be
+    picked up once completed."""
+    from mpisppy_tpu.telemetry import watch as w
+
+    path = str(tmp_path / "t.jsonl")
+    _fault_domain_trace(path)
+    rows = open(path).read().splitlines()
+    # rewrite with the final line torn mid-object, no newline
+    keep, last = rows[:-1], rows[-1]
+    with open(path, "w") as f:
+        f.write("\n".join(keep) + "\n" + last[: len(last) // 2])
+    state = w.WatchState()
+    pos = w._follow(path, state, 0)
+    assert state.events == len(keep)          # torn line NOT consumed
+    assert state.end is None
+    # the writer finishes the line (plus one more event) — the tailer
+    # resumes from its offset and sees both exactly once
+    with open(path, "a") as f:
+        f.write(last[len(last) // 2:] + "\n")
+    pos = w._follow(path, state, pos)
+    assert state.events == len(rows)
+    assert state.end is not None              # run-end landed
+    assert state.dispatch_retries == 1
+    assert state.dispatch_quarantined == 3
+    assert state.watchdog_trips == 1   # fail-fast is not a trip
+    # a torn line that never completes (writer died) parses as garbage
+    # once newline-terminated and is skipped, not fatal
+    with open(path, "a") as f:
+        f.write('{"kind": "hub-iter')
+    pos2 = w._follow(path, state, pos)
+    assert pos2 == pos and state.events == len(rows)
+    with open(path, "a") as f:
+        f.write("\n")
+    pos3 = w._follow(path, state, pos2)
+    assert pos3 > pos2 and state.events == len(rows)   # skipped
+    # the CLI smoke mode renders the resilience line from this state
+    rendered = w.render_status(state)
+    assert "retries 1" in rendered and "quarantined lanes 3" in rendered
+
+
+def test_watch_once_cli_on_fault_domain_trace(tmp_path):
+    path = str(tmp_path / "fd.jsonl")
+    _fault_domain_trace(path)
+    out = subprocess.run(CLI + ["watch", "--trace-jsonl", path,
+                                "--once"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "RUN ENDED: max-iter" in out.stdout
+    assert "quarantined lanes 3" in out.stdout

@@ -32,6 +32,10 @@ ALLOWED_FILES = {
     "__main__.py",            # CLI entry point
     "parallel/_multihost_dryrun.py",  # multihost smoke entry point
     "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
+    "resilience/watchdog.py",  # abort-path last words go straight to
+                               # stderr: the telemetry console may be
+                               # wedged inside the very stall the
+                               # watchdog is escaping (ISSUE 9)
 }
 
 MARKER = "telemetry: allow-print"
