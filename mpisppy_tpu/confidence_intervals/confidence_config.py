@@ -18,6 +18,16 @@ def sequential_config(cfg):
     cfg.add_to_config("ArRP", "pooled estimator count", int, 1)
     cfg.add_to_config("kf_Gs", "resampling frequency for G and s", int, 1)
     cfg.add_to_config("kf_xhat", "resampling frequency for xhat", int, 1)
+    # programmatic-only knobs (no CLI flag): seqsampling reads these
+    # off the cfg when a driver quick_assigns them
+    # (ref:seqsampling.py options plumbing)
+    cfg.add_to_config("growth_function",
+                      "BPL sample-growth callable g(k) (programmatic; "
+                      "default linear k-1)", object, None,
+                      argparse=False)
+    cfg.add_to_config("xhat_gen_kwargs",
+                      "extra kwargs for the xhat generator "
+                      "(programmatic)", dict, None, argparse=False)
 
 
 def BM_config(cfg):

@@ -238,30 +238,40 @@ class SolveScheduler:
         # dispatch path only, zero jit-graph impact
         self.fault_plan = fault_plan
         self.ladder = _buckets.BucketLadder(options.bucket_growth)
+        # Lock discipline is lint-enforced (tools/graftlint
+        # lock-discipline pass, docs/static_analysis.md): every field
+        # below annotated `# guarded-by: _lock` may only be touched
+        # inside `with self._lock` (or `with self._wake` — a Condition
+        # over the same lock), or in a method marked
+        # `# holds-lock: _lock` whose caller holds it.  Deliberately
+        # UNannotated shared state: `options` (immutable dataclass,
+        # swapped atomically under the lock by degrade(); bare reads
+        # see either complete value), the sync primitives themselves,
+        # and init-frozen handles (ladder/_watch/solve_fn/bus/run).
         self._lock = threading.Lock()
         self._sem = threading.Semaphore(max(1, options.max_inflight))
-        self._pending: dict = {}          # key -> open _Window
+        self._pending: dict = {}          # guarded-by: _lock
         self._watch = _cw.CompileWatch()
-        self._dispatcher = None
+        self._dispatcher = None           # guarded-by: _lock
         self._wake = threading.Condition(self._lock)
-        self._closed = False
-        self._degraded = False
-        self._next_sid = 0                # submit ids (fault-seam joins)
-        self._attempts = 0                # dispatch attempts incl retries
+        self._closed = False              # guarded-by: _lock
+        self._degraded = False            # guarded-by: _lock
+        self._next_sid = 0                # guarded-by: _lock
+        self._attempts = 0                # guarded-by: _lock
         # -- stats (all also mirrored into the metrics REGISTRY) ----------
-        self._buckets: dict = {}          # shape signature -> dispatches
-        self._inflight = 0
-        self._inflight_max = 0
-        self._batches = 0
-        self._lanes = 0
-        self._pad_lanes = 0
-        self._coalesced_lanes = 0         # lanes that shared a dispatch
-        self._unexpected_recompiles = 0
-        self._dispatch_compiles = 0       # compiles DURING solve windows
-        self._retries = 0                 # re-dispatched attempt count
-        self._quarantined_lanes = 0       # lanes resolved as SolveFailed
-        self._quarantined_requests = 0
-        self._dispatcher_deaths = 0
+        self._buckets: dict = {}          # guarded-by: _lock
+        self._inflight = 0                # guarded-by: _lock
+        self._inflight_max = 0            # guarded-by: _lock
+        self._batches = 0                 # guarded-by: _lock
+        self._lanes = 0                   # guarded-by: _lock
+        self._pad_lanes = 0               # guarded-by: _lock
+        self._coalesced_lanes = 0         # guarded-by: _lock
+        self._unexpected_recompiles = 0   # guarded-by: _lock
+        self._dispatch_compiles = 0       # guarded-by: _lock
+        self._retries = 0                 # guarded-by: _lock
+        self._quarantined_lanes = 0       # guarded-by: _lock
+        self._quarantined_requests = 0    # guarded-by: _lock
+        self._dispatcher_deaths = 0       # guarded-by: _lock
         # why windows dispatched: timer (admission deadline expiry),
         # size (max_batch reached), inline (a caller's unbounded
         # result()), expedite (a deadline-bounded result()), overflow
@@ -269,7 +279,7 @@ class SolveScheduler:
         # stats() split that lets the analyzer attribute occupancy loss
         # to admission timeouts vs size-forced dispatch (ISSUE 9
         # satellite)
-        self._by_cause: dict = {}
+        self._by_cause: dict = {}         # guarded-by: _lock
 
     # -- public API -------------------------------------------------------
     def solve_mip(self, qp, d_col, int_cols, opts=None, **kwargs):
@@ -404,7 +414,8 @@ class SolveScheduler:
             self._wake.notify_all()
         for w in wins:
             self._drive(w, cause="close")
-        t = self._dispatcher
+        with self._lock:
+            t = self._dispatcher
         if t is not None and t.is_alive():
             t.join(timeout=5.0)
 
@@ -436,7 +447,7 @@ class SolveScheduler:
                 ("solo", id(kwargs)) if kwargs else ())
 
     # -- dispatch machinery -----------------------------------------------
-    def _ensure_dispatcher(self):
+    def _ensure_dispatcher(self):        # holds-lock: _lock
         """Lazy daemon that fires windows whose admission timer lapsed
         (callers that block in result() drive their own windows; this
         thread only covers fire-and-forget submits).  Caller holds the
@@ -741,13 +752,21 @@ class SolveScheduler:
                 k: _buckets.pad_leading_rows(v, S_tot, S_pad)
                 for k, v in kwargs.items()}
         sig = _buckets.shape_signature(qp, d_col) + (opts,)
-        warm = sig in self._buckets
+        with self._lock:
+            warm = sig in self._buckets
         before = self._watch.total()
         res = self._solve_attempt(reqs, qp, d_col, int_cols, opts,
                                   kwargs)
         compiled = self._watch.total() - before
-        self._dispatch_compiles += compiled
-        if warm and compiled and self._inflight == 1:
+        with self._lock:
+            # += on a counter from concurrent dispatch threads is a
+            # lost-update race without the lock — found by the
+            # lock-discipline lint when the guarded-by audit landed
+            # (ISSUE 10); same for the warm-bucket read above and the
+            # solo-inflight read below
+            self._dispatch_compiles += compiled
+            solo = self._inflight == 1
+        if warm and compiled and solo:
             # ADVISORY attribution: the counter is only read with one
             # dispatch in flight, but compiles from OTHER threads (a
             # hub step compiling a wheel kernel) and legitimately
@@ -756,7 +775,8 @@ class SolveScheduler:
             # land in the window.  That is why the default only counts;
             # compile_guard is the strict dev/test mode that turns the
             # count into an assertion on workloads known to be clean.
-            self._unexpected_recompiles += compiled
+            with self._lock:
+                self._unexpected_recompiles += compiled
             _metrics.REGISTRY.inc("dispatch_unexpected_recompiles_total",
                                   compiled)
             if self.options.compile_guard:
@@ -822,15 +842,21 @@ class SolveScheduler:
             self._by_cause[win.cause] = \
                 self._by_cause.get(win.cause, 0) + 1
             queue_depth = sum(len(w.reqs) for w in self._pending.values())
+            # snapshot everything the unlocked metric/event writes
+            # below read — the renders must see one consistent point
+            # in time (lock-discipline lint, ISSUE 10)
+            n_buckets = len(self._buckets)
+            dispatch_compiles = self._dispatch_compiles
+            inflight_max = self._inflight_max
         R = _metrics.REGISTRY
         R.inc("dispatch_batches_total")
         R.inc("dispatch_lanes_total", real)
         R.inc("dispatch_pad_lanes_total", S_pad - real)
         R.set_gauge("dispatch_batch_occupancy", occ)
         R.set_gauge("dispatch_queue_depth", queue_depth)
-        R.set_gauge("dispatch_buckets_active", len(self._buckets))
+        R.set_gauge("dispatch_buckets_active", n_buckets)
         R.set_counter("dispatch_backend_compiles_total",
-                      self._dispatch_compiles)
+                      dispatch_compiles)
         if self.bus is not None:
             from mpisppy_tpu import telemetry as tel
             self.bus.emit(
@@ -840,7 +866,7 @@ class SolveScheduler:
                 occupancy=occ, bucket=list(sig[:3]),
                 wait_ms=1e3 * (t_launch - win.t0),
                 queue_depth=queue_depth, cause=win.cause,
-                inflight_max=self._inflight_max)
+                inflight_max=inflight_max)
 
 
 # -- the process-default scheduler (prometheus_client-style global) ---------

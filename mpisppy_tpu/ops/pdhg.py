@@ -407,7 +407,8 @@ def _lane_guard(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> PDHGState:
 def _use_pallas_window(p: BoxQP, st: PDHGState, opts: PDHGOptions) -> bool:
     """Engine choice, resolved at TRACE time (all inputs static)."""
     if opts.use_pallas is not None:
-        return bool(opts.use_pallas)
+        # static options field, not a device value
+        return bool(opts.use_pallas)      # graftlint: allow-host-sync
     from mpisppy_tpu.ops import pdhg_pallas
     # measured crossover on v5e (sslp shapes): XLA wins to ~10k
     # scenarios (partial VMEM residency), the kernel wins at ~100k
@@ -503,9 +504,27 @@ def solve(p: BoxQP, opts: PDHGOptions = PDHGOptions(),
     if not traced and will_chunk(opts):
         while True:
             st = _dispatch_capped(p, opts, st)
+            # the documented host seam of the auto-chunk loop: one
+            # scalar read between capped dispatches decides whether to
+            # re-dispatch                       # graftlint: allow-host-sync
             if int(st.k) >= opts.max_iters or bool(jnp.all(st.done)):
                 return st
 
+    # ALWAYS through the jitted, shape-keyed loop.  Called eagerly the
+    # while_loop would close over p's VALUES as jaxpr constants — one
+    # silent XLA compile per distinct QP per call, the same leak class
+    # the dispatch compile guard caught in estimate_norm after PR 4
+    # (now also flagged at lint time: tools/graftlint trace-purity).
+    # Inside an outer trace the nested jit inlines, so traced callers
+    # compile exactly what they did before.
+    return _solve_loop_jit(p, opts, st)
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def _solve_loop_jit(p: BoxQP, opts: PDHGOptions,
+                    st: PDHGState) -> PDHGState:
+    """The run-to-tolerance while_loop, jitted so host-level solve()
+    calls key the compile cache on shapes+opts, never on QP values."""
     def cond(s):
         return (s.k < opts.max_iters) & ~jnp.all(s.done)
 
@@ -551,7 +570,20 @@ def solve_fixed(p: BoxQP, n_windows: int, opts: PDHGOptions,
     if opts.telemetry and st.counters is None:
         st = dataclasses.replace(
             st, counters=_init_counters(st.omega.shape, st.x.dtype, opts))
-    return jax.lax.fori_loop(0, n_windows, lambda _, s: _window(p, s, opts), st)
+    return _solve_fixed_jit(p, n_windows, opts, st)
+
+
+@partial(jax.jit, static_argnames=("n_windows", "opts"))
+def _solve_fixed_jit(p: BoxQP, n_windows: int, opts: PDHGOptions,
+                     st: PDHGState) -> PDHGState:
+    """Fixed-budget window loop, jitted for the same reason as
+    _solve_loop_jit: an eager fori_loop bakes QP values into the jaxpr
+    and recompiles per call (PH hot loops call this inside their own
+    jit, where the nested jit inlines — but host-level callers, e.g. a
+    spoke's first warm-up solve, used to pay one silent backend
+    compile per distinct QP)."""
+    return jax.lax.fori_loop(0, n_windows,
+                             lambda _, s: _window(p, s, opts), st)
 
 
 solve_batch = solve  # batching is implicit via leading axes

@@ -59,11 +59,15 @@ class HubWatchdog:
         self.interval_s = max(0.01, float(interval_s)) \
             if interval_s is not None else max(0.05, self.budget_s / 4.0)
         self.abort_fn = abort_fn or os._exit
+        # trips/degraded are touched only on the supervisor thread
+        # (and read by tests after stop()); the beat path shares only
+        # the two _lock-guarded fields below (lint-enforced:
+        # tools/graftlint lock-discipline)
         self.trips = 0
         self.degraded = False
         self._lock = threading.Lock()
-        self._last_progress = time.perf_counter()
-        self._last = (None, None, None)   # (iter, outer, inner)
+        self._last_progress = time.perf_counter()  # guarded-by: _lock
+        self._last = (None, None, None)            # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -26,11 +26,13 @@ MAX_SINK_ERRORS = 3
 
 class EventBus:
     def __init__(self):
+        # lint-enforced discipline (tools/graftlint lock-discipline):
+        # sequence numbering and sink fan-out are serialized by _lock
         self._lock = threading.Lock()
-        self._sinks: list = []
-        self._errors: dict[int, int] = {}  # id(sink) -> consecutive fails
-        self._seq = 0
-        self.closed = False
+        self._sinks: list = []             # guarded-by: _lock
+        self._errors: dict[int, int] = {}  # guarded-by: _lock
+        self._seq = 0                      # guarded-by: _lock
+        self.closed = False                # guarded-by: _lock
 
     # -- subscription -----------------------------------------------------
     def subscribe(self, sink) -> None:

@@ -44,16 +44,19 @@ class FlightRecorder(Sink):
                  dump_dir: str = "."):
         self.capacity = max(1, int(capacity))
         self.dump_dir = dump_dir
-        self._ring: list = [None] * self.capacity
-        self._count = 0          # total events ever seen
-        self._run = ""           # last non-empty run id seen
-        self.dumped_to: str | None = None  # last dump path (for tests)
         # handle() can run on the background checkpoint-writer daemon
         # (bus.emit is called from it) while dump() runs on the crash
         # path of the main thread — without this lock a dump racing an
         # emit could tear the ring snapshot (duplicate the newest
-        # event into the oldest slot, drop the true oldest)
+        # event into the oldest slot, drop the true oldest).  Lint-
+        # enforced: tools/graftlint lock-discipline.
         self._lock = threading.Lock()
+        self._ring: list = [None] * self.capacity   # guarded-by: _lock
+        self._count = 0          # total events seen  # guarded-by: _lock
+        self._run = ""           # last non-empty run  # guarded-by: _lock
+        self.dumped_to: str | None = None  # last dump path (crash-path
+                                           # thread only; read by tests
+                                           # after the dump)
 
     # -- sink interface ---------------------------------------------------
     def handle(self, event: ev.Event) -> None:
@@ -74,12 +77,14 @@ class FlightRecorder(Sink):
 
     @property
     def run(self) -> str:
-        return self._run or "unknown"
+        with self._lock:
+            return self._run or "unknown"
 
     @property
     def dropped(self) -> int:
         """Events that fell off the ring (seen minus buffered)."""
-        return max(0, self._count - self.capacity)
+        with self._lock:
+            return max(0, self._count - self.capacity)
 
     # -- the black-box dump -----------------------------------------------
     def dump(self, reason: str = "", path: str | None = None) -> str:

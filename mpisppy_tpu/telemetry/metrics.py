@@ -27,6 +27,44 @@ import time
 
 SNAPSHOT_SCHEMA = "mpisppy-tpu-metrics/1"
 
+#: The declared metric vocabulary (ISSUE 10 schema-drift pass): every
+#: literal metric name recorded anywhere in the library must appear
+#: here, so a typo'd or ad-hoc name is a lint failure instead of a
+#: silently forked time series (`python -m tools.graftlint`).  Names
+#: are grouped by producer; labels (cyl=, kind=) are orthogonal to the
+#: base name and not part of the schema.
+ALL_METRICS = frozenset({
+    # telemetry spine (sinks.py, hub checkpoint path)
+    "events_total",
+    "checkpoint_writes_total",
+    # on-device PDHG kernel counters (counters.py harvest)
+    "pdhg_iterations_total",
+    "pdhg_restarts_total",
+    "pdhg_omega_adaptations_total",
+    "pdhg_guard_resets_total",
+    "pdhg_windows_total",
+    "pdhg_last_score_median",
+    # host-driven B&B (ops/bnb.py)
+    "bnb_nodes_solved_total",
+    "bnb_lanes_closed_total",
+    # dispatch scheduler (dispatch/scheduler.py; docs/dispatch.md)
+    "dispatch_batches_total",
+    "dispatch_lanes_total",
+    "dispatch_pad_lanes_total",
+    "dispatch_batch_occupancy",
+    "dispatch_queue_depth",
+    "dispatch_buckets_active",
+    "dispatch_inflight",
+    "dispatch_backend_compiles_total",
+    "dispatch_unexpected_recompiles_total",
+    "dispatch_retries_total",
+    "dispatch_quarantined_lanes_total",
+    "dispatch_quarantined_requests_total",
+    "dispatch_dispatcher_deaths_total",
+    # supervisors (resilience/watchdog.py)
+    "watchdog_trips_total",
+})
+
 
 def _key(name: str, labels: dict | None) -> str:
     if not labels:
@@ -41,8 +79,8 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
+        self._counters: dict[str, float] = {}  # guarded-by: _lock
+        self._gauges: dict[str, float] = {}    # guarded-by: _lock
 
     # -- recording --------------------------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels):

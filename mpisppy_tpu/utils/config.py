@@ -112,8 +112,6 @@ class Config:
                            None)
         self.add_to_config("display_progress", "per-iter trace", bool,
                            False)
-        self.add_to_config("tee_rank0_solves", "verbose solves", bool,
-                           False)
         self.add_to_config("pdhg_tol", "subproblem KKT tolerance", float,
                            1e-6)
         self.add_to_config("subproblem_windows",
@@ -171,7 +169,9 @@ class Config:
                            "adapt gamma from the u/v norm decrease ratio",
                            bool, False)
         # legacy alias (the listener-consensus fraction has no analog in
-        # the single-program design; kept so reference scripts parse)
+        # the single-program design; kept so reference scripts parse —
+        # an INTENTIONAL parse-only no-op, not a dead knob)
+        # graftlint: allow-config-knob
         self.add_to_config("aph_frac_needed",
                            "legacy parse-only no-op (listener consensus "
                            "fraction; use --aph-dispatch-frac)", float, 1.0)
@@ -327,11 +327,6 @@ class Config:
                            "primal-dual converger", bool, False)
         self.add_to_config("primal_dual_converger_tol",
                            "pd converger tolerance", float, 1e-2)
-
-    def tracking_args(self):
-        """ref:config.py:911-949."""
-        self.add_to_config("tracking_folder", "csv trace folder", str,
-                           None)
 
     def wxbar_read_write_args(self):
         """ref:config.py:950-975."""

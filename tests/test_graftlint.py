@@ -1,0 +1,470 @@
+# graftlint static-analysis suite (ISSUE 10; tools/graftlint/,
+# docs/static_analysis.md): per-rule seeded-violation fixtures, the
+# clean-repo tier-1 run, suppression + baseline round trips, --json
+# schema stability, and the trace-purity satellite's compile-count
+# regression test on ops/pdhg.solve.
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from tools import graftlint  # noqa: E402
+from tools.graftlint.core import Context, load_baseline  # noqa: E402
+from tools.graftlint import (  # noqa: E402
+    rules_config_knob, rules_host_sync, rules_lock_discipline,
+    rules_no_print, rules_readme_claims, rules_schema_drift,
+    rules_trace_purity,
+)
+
+
+def mini_repo(tmp_path, files: dict[str, str]):
+    """A throwaway repo tree with an mpisppy_tpu/ lib dir."""
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return Context(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 wiring: the repo itself lints clean on every pass
+# ---------------------------------------------------------------------------
+def test_repo_lints_clean():
+    rep = graftlint.lint(REPO)
+    msgs = [f"{f['path']}:{f['line']} [{f['rule']}] {f['message']}"
+            for f in rep["findings"] if not f["baselined"]]
+    assert rep["errors"] == [] and msgs == [], "\n".join(msgs)
+
+
+def test_required_empty_baseline_rules():
+    """ISSUE 10 acceptance: lock-discipline / schema-drift /
+    config-knob carry NO baseline entries (trace-purity and host-sync
+    may, with justification — currently none do)."""
+    entries, errors = load_baseline(graftlint.DEFAULT_BASELINE)
+    assert errors == []
+    banned = {"lock-discipline", "schema-drift", "config-knob",
+              "no-print", "readme-claims"}
+    assert not [k for k in entries if k[0] in banned]
+
+
+# ---------------------------------------------------------------------------
+# rule 1: trace-purity
+# ---------------------------------------------------------------------------
+def test_trace_purity_catches_eager_control_flow(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=())
+        def fine(x):
+            return jax.lax.fori_loop(0, 3, lambda i, s: s + x, x)
+
+        def _helper(x):  # private, only called from the jitted entry
+            return jax.lax.scan(lambda c, _: (c, c), x, None)
+
+        def fine_caller_jit(x):
+            return _helper(x)
+
+        def leaky(x):
+            return jax.lax.while_loop(lambda s: s.any(),
+                                      lambda s: s - x, x)
+    """})
+    found = {(f.key.split("::")[1], f.line)
+             for f in rules_trace_purity.run(ctx)}
+    assert ("leaky", 16) in {(k, ln) for k, ln in found}
+    assert all(k == "leaky" for k, _ in found), found
+
+
+def test_trace_purity_private_method_inherits_via_jitted_sibling(tmp_path):
+    # self._body is only reachable through the jitted step() — the
+    # class-qualified call edge must feed the protection fixed point
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        class K:
+            @partial(jax.jit, static_argnums=0)
+            def step(self, x):
+                return self._body(x)
+
+            def _body(self, x):
+                return jax.lax.scan(lambda c, _: (c, c), x, None)
+
+            def _orphan(self, x):   # no caller: stays unprotected
+                return jax.lax.cond(x.any(), lambda v: v,
+                                    lambda v: -v, x)
+    """})
+    names = {f.key.split("::")[1] for f in rules_trace_purity.run(ctx)}
+    assert names == {"K._orphan"}, names
+
+
+def test_trace_purity_catches_per_call_jit_wrapper(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+
+        def hot(x):
+            f = jax.jit(lambda v: v + 1)   # fresh wrapper per call
+            return f(x)
+    """})
+    msgs = [f.message for f in rules_trace_purity.run(ctx)]
+    assert any("jit(lambda)" in m for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
+# rule 2: lock-discipline
+# ---------------------------------------------------------------------------
+LOCK_MOD = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._wake = threading.Condition(self._lock)
+            self._n = 0            # guarded-by: _lock
+
+        def good(self):
+            with self._lock:
+                self._n += 1
+
+        def good_via_condition(self):
+            with self._wake:
+                self._n += 1
+
+        def good_caller_holds(self):   # holds-lock: _lock
+            self._n += 1
+
+        def bad(self):
+            self._n += 1
+"""
+
+
+def test_lock_discipline_catches_unguarded_access(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": LOCK_MOD})
+    found = rules_lock_discipline.run(ctx)
+    assert len(found) == 1 and "bad()" in found[0].message
+
+
+def test_lock_discipline_nested_def_does_not_inherit(tmp_path):
+    # a closure handed to a thread must not inherit the lexical lock
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0        # guarded-by: _lock
+
+            def spawn(self):
+                with self._lock:
+                    def worker():
+                        self._n += 1   # runs on another thread
+                    return worker
+    """})
+    found = rules_lock_discipline.run(ctx)
+    assert len(found) == 1 and "spawn()" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# rule 3: host-sync
+# ---------------------------------------------------------------------------
+def test_host_sync_catches_syncs_in_hot_kernels(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/ops/pdhg.py": """
+        import numpy as np
+
+        def step(st):
+            v = st.x.item()
+            w = np.asarray(st.y)
+            st.x.block_until_ready()
+            k = int(st.k)
+            return v, w, k
+
+        def fine(st):
+            n = int(3)             # literal: never a sync
+            ok = int(st.k)         # graftlint: allow-host-sync
+            return n, ok
+    """, "mpisppy_tpu/ops/bnb.py": """
+        import numpy as np
+
+        def harvest(res):
+            return np.asarray(res)   # host orchestrator: exempt
+    """})
+    found = [f for f in rules_host_sync.run(ctx)
+             if not ctx.suppressed(f.path, f.line, f.rule)]
+    kinds = sorted(f.message.split(" in a hot")[0] for f in found)
+    assert len(found) == 4, kinds
+    assert all("pdhg.py" in f.path for f in found)
+
+
+def test_host_sync_keys_are_per_occurrence(tmp_path):
+    """Two same-kind syncs in one function must get DISTINCT baseline
+    keys — a shared key would let one grandfathered entry silently
+    cover a future violation landing nearby."""
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/ops/pdhg.py": """
+        def f(st):
+            a = st.x.item()
+            b = st.y.item()
+            return a, b
+    """})
+    keys = [f.key for f in rules_host_sync.run(ctx)]
+    assert len(keys) == 2 and len(set(keys)) == 2, keys
+    assert all("::f::" in k for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# rule 4: schema-drift
+# ---------------------------------------------------------------------------
+SD_EVENTS = """
+    FOO = "foo-kind"
+    BAR = "bar-kind"
+    ALL_KINDS = frozenset(v for k, v in list(globals().items())
+                          if k.isupper() and isinstance(v, str))
+"""
+SD_METRICS = """
+    ALL_METRICS = frozenset({"good_total"})
+    class R: pass
+    REGISTRY = R()
+"""
+SD_DOC = """
+    # doc
+    | kind | when |
+    |------|------|
+    | `foo-kind` | x |
+"""
+
+
+def test_schema_drift_catches_unknown_kind_and_metric(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "mpisppy_tpu/telemetry/events.py": SD_EVENTS,
+        "mpisppy_tpu/telemetry/metrics.py": SD_METRICS,
+        "docs/telemetry.md": SD_DOC,
+        "mpisppy_tpu/emitter.py": """
+            from mpisppy_tpu.telemetry.metrics import REGISTRY
+
+            def go(bus):
+                bus.emit("foo-kind", x=1)      # declared: fine
+                bus.emit("tyop-kind", x=1)     # NOT declared
+                REGISTRY.inc("good_total")     # registered: fine
+                REGISTRY.inc("typo_total")     # NOT registered
+        """})
+    keys = {f.key for f in rules_schema_drift.run(ctx)}
+    assert "mpisppy_tpu/emitter.py::emit::tyop-kind" in keys
+    assert "mpisppy_tpu/emitter.py::metric::typo_total" in keys
+    # bar-kind is declared but has no doc row
+    assert "doc-missing::bar-kind" in keys
+    assert not any("foo-kind" in k or "good_total" in k for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# rule 5: config-knob
+# ---------------------------------------------------------------------------
+def test_config_knob_catches_undeclared_and_dead(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "mpisppy_tpu/utils/config.py": """
+            class Config:
+                def add_to_config(self, name, description, domain=str,
+                                  default=None, argparse=True):
+                    pass
+                def get(self, name, default=None):
+                    pass
+                def my_args(self):
+                    self.add_to_config("live_knob", "used", int, 1)
+                    self.add_to_config("dead_knob", "unused", int, 1)
+                    # graftlint: allow-config-knob
+                    self.add_to_config("legacy_knob", "alias", int, 1)
+        """,
+        "mpisppy_tpu/consumer.py": """
+            def use(cfg):
+                a = cfg.get("live_knob", 1)
+                b = cfg.get("ghost_knob")     # never declared
+                return a, b
+        """})
+    found = [f for f in rules_config_knob.run(ctx)
+             if not ctx.suppressed(f.path, f.line, f.rule)]
+    keys = {f.key for f in found}
+    assert "mpisppy_tpu/consumer.py::undeclared::ghost_knob" in keys
+    assert "dead::dead_knob" in keys
+    assert "dead::legacy_knob" not in keys      # suppressed alias
+    assert "dead::live_knob" not in keys
+
+
+# ---------------------------------------------------------------------------
+# rules 6+7: the folded-in legacy passes (shims covered by the
+# pre-existing tests in test_telemetry / test_observability)
+# ---------------------------------------------------------------------------
+def test_no_print_rule_fixture(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        print("dbg")
+        print("{}")  # telemetry: allow-print
+        # print( in a comment is fine
+    """})
+    found = rules_no_print.run(ctx)
+    assert len(found) == 1 and found[0].line == 2
+
+
+def test_readme_claims_rule_fixture(tmp_path):
+    (tmp_path / "README.md").write_text(
+        "Measured on one chip:\n\n"
+        "- hits the gap in 999 s (bf16x3)\n\n"
+        "Out of scope: nothing.\n")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"phase": {"seconds_to_gap": 42.0}}))
+    ctx = Context(str(tmp_path))
+    found = rules_readme_claims.run(ctx)
+    assert len(found) == 1 and "999s" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# framework: suppression, baseline round trip, CLI + --json schema
+# ---------------------------------------------------------------------------
+def test_inline_suppression_same_and_preceding_line(tmp_path):
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        print("a")  # graftlint: allow-no-print
+        # graftlint: allow-no-print
+        print("b")
+        print("c")
+    """})
+    rep = graftlint.lint(str(tmp_path), rules=["no-print"])
+    # line 2 suppressed same-line, line 4 by the preceding comment;
+    # only the bare line-5 print survives
+    lines = [f["line"] for f in rep["findings"]]
+    assert lines == [5]
+
+
+def test_baseline_round_trip(tmp_path):
+    mini_repo(tmp_path, {"mpisppy_tpu/mod.py": 'print("x")\n'})
+    base = tmp_path / "baseline.json"
+    rep = graftlint.lint(str(tmp_path), rules=["no-print"],
+                         baseline_path=str(base))
+    assert rep["active"] == 1 and not rep["ok"]
+    key = rep["findings"][0]["key"]
+    # grandfather it WITH a justification -> ok
+    base.write_text(json.dumps({
+        "schema": "graftlint-baseline/1",
+        "entries": [{"rule": "no-print", "key": key,
+                     "why": "legacy CLI output, migrating in PR N+1"}]}))
+    rep2 = graftlint.lint(str(tmp_path), rules=["no-print"],
+                          baseline_path=str(base))
+    assert rep2["ok"] and rep2["baselined"] == 1 and rep2["active"] == 0
+    # an entry without `why` is itself a failure
+    base.write_text(json.dumps({
+        "schema": "graftlint-baseline/1",
+        "entries": [{"rule": "no-print", "key": key}]}))
+    rep3 = graftlint.lint(str(tmp_path), rules=["no-print"],
+                          baseline_path=str(base))
+    assert not rep3["ok"] and any("why" in e for e in rep3["errors"])
+    # a stale entry (finding fixed, entry left behind) is a failure
+    (tmp_path / "mpisppy_tpu" / "mod.py").write_text("x = 1\n")
+    base.write_text(json.dumps({
+        "schema": "graftlint-baseline/1",
+        "entries": [{"rule": "no-print", "key": key, "why": "gone"}]}))
+    rep4 = graftlint.lint(str(tmp_path), rules=["no-print"],
+                          baseline_path=str(base))
+    assert not rep4["ok"] and any("stale" in e for e in rep4["errors"])
+
+
+def test_cli_json_schema_stability(tmp_path):
+    mini_repo(tmp_path, {"mpisppy_tpu/mod.py": 'print("x")\n'})
+    env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "HOME": os.path.expanduser("~")}
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json",
+         "--root", str(tmp_path), "--rules", "no-print"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 1, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["schema"] == "graftlint-report/1"
+    f = rep["findings"][0]
+    assert set(f) == {"rule", "path", "line", "message", "key",
+                      "baselined"}
+    assert rep["active"] == 1 and rep["rules"] == ["no-print"]
+    # clean tree -> exit 0
+    (tmp_path / "mpisppy_tpu" / "mod.py").write_text("x = 1\n")
+    out2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--root",
+         str(tmp_path), "--rules", "no-print"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+
+
+def test_unknown_rule_name_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        graftlint.lint(REPO, rules=["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# the golden dispatch trace fixture backs the GATES witness check
+# ---------------------------------------------------------------------------
+def test_golden_dispatch_trace_carries_gate_keys():
+    """The committed fixture exists so regress.GATES' backend_compiles
+    / unexpected_recompiles patterns resolve against a committed
+    artifact (schema-drift check 4) — guard the coupling."""
+    import re
+    from mpisppy_tpu.telemetry import analyze, regress
+    rep = analyze.analyze_path(os.path.join(
+        HERE, "fixtures", "golden_dispatch_trace.jsonl"))
+    keys = set(regress.extract_metrics(rep))
+    for pat in ("backend_compiles", "unexpected_recompiles"):
+        assert any(re.search(pat, k) for k in keys), (pat, sorted(keys))
+
+
+# ---------------------------------------------------------------------------
+# trace-purity satellite: the pdhg host-level solve recompile leak is
+# FIXED (not baselined) — compile-count regression test
+# ---------------------------------------------------------------------------
+def _toy_qp(seed: int):
+    import numpy as np
+    import jax.numpy as jnp
+    from mpisppy_tpu.ops.boxqp import BoxQP
+    r = np.random.default_rng(seed)
+    n, m, S = 6, 4, 3
+    A = jnp.asarray(r.normal(size=(m, n)).astype(np.float32))
+    c = jnp.asarray(r.normal(size=(S, n)).astype(np.float32))
+    return BoxQP(c=c, q=jnp.zeros_like(c), A=A,
+                 bl=jnp.full((m,), -1.0, jnp.float32),
+                 bu=jnp.full((m,), 1.0, jnp.float32),
+                 l=jnp.full((n,), -2.0, jnp.float32),
+                 u=jnp.full((n,), 2.0, jnp.float32))
+
+
+def test_pdhg_host_solve_does_not_recompile_per_qp():
+    """Pre-fix, host-level pdhg.solve() below the dispatch_cap ran an
+    EAGER while_loop closing over the QP values as jaxpr constants —
+    one silent backend compile per distinct QP (the exact leak class
+    the PR-4 runtime guard caught in estimate_norm, now lint-flagged
+    by graftlint trace-purity and fixed via _solve_loop_jit)."""
+    from mpisppy_tpu.dispatch import compilewatch
+    from mpisppy_tpu.ops import pdhg
+    opts = pdhg.PDHGOptions(tol=1e-5, max_iters=2000)
+    assert not pdhg.will_chunk(opts)     # the leaky (non-chunked) path
+    watch = compilewatch.CompileWatch()
+    st = pdhg.solve(_toy_qp(0), opts)    # warm the shape+opts key
+    assert bool(st.done.all())
+    warm = watch.total()
+    for seed in (1, 2, 3):               # same shapes, fresh values
+        st = pdhg.solve(_toy_qp(seed), opts)
+        assert bool(st.done.all())
+    assert watch.total() == warm, \
+        "host-level solve recompiled for same-shape QPs"
+
+
+def test_pdhg_solve_fixed_does_not_recompile_per_qp():
+    from mpisppy_tpu.dispatch import compilewatch
+    from mpisppy_tpu.ops import pdhg
+    opts = pdhg.PDHGOptions(tol=1e-5)
+    watch = compilewatch.CompileWatch()
+    qp = _toy_qp(7)
+    pdhg.solve_fixed(qp, 4, opts, pdhg.init_state(qp, opts))
+    warm = watch.total()
+    qp2 = _toy_qp(8)
+    pdhg.solve_fixed(qp2, 4, opts, pdhg.init_state(qp2, opts))
+    assert watch.total() == warm, \
+        "host-level solve_fixed recompiled for same-shape QPs"

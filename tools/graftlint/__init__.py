@@ -1,0 +1,77 @@
+###############################################################################
+# graftlint — the project's static-analysis suite (ISSUE 10;
+# docs/static_analysis.md).
+#
+#   python -m tools.graftlint [--json] [--rules a,b] [paths]
+#
+# Seven passes over mpisppy_tpu/ (see docs/static_analysis.md for the
+# rule catalog, suppression syntax and baseline workflow):
+#
+#   trace-purity     eager lax control flow / per-call jit wrappers —
+#                    the PR-4 recompile-leak class, at lint time
+#   lock-discipline  `# guarded-by:` fields touched outside their lock
+#   host-sync        device->host syncs inside the iteration kernels
+#   schema-drift     event kinds vs ALL_KINDS vs docs table; metric
+#                    names vs ALL_METRICS; GATES/MILESTONES vs
+#                    committed artifacts
+#   config-knob      undeclared cfg reads + dead declared knobs
+#   no-print         bare print( in library code
+#   readme-claims    README perf numbers vs committed BENCH artifacts
+#
+# When this package is imported with `tools` not on sys.path (the
+# legacy shims add tools/ itself), the absolute `tools.graftlint`
+# imports inside the rule modules still need the repo root — resolved
+# here once.
+###############################################################################
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.graftlint.core import (  # noqa: E402,F401 (re-exports)
+    BASELINE_SCHEMA, Context, Finding, Rule, load_baseline, run_rules,
+)
+from tools.graftlint import (  # noqa: E402
+    rules_config_knob, rules_host_sync, rules_lock_discipline,
+    rules_no_print, rules_readme_claims, rules_schema_drift,
+    rules_trace_purity,
+)
+
+#: registration order = documentation order (docs/static_analysis.md)
+ALL_RULES = (
+    rules_trace_purity.RULE,
+    rules_lock_discipline.RULE,
+    rules_host_sync.RULE,
+    rules_schema_drift.RULE,
+    rules_config_knob.RULE,
+    rules_no_print.RULE,
+    rules_readme_claims.RULE,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def lint(root: str, paths: list[str] | None = None,
+         rules: list[str] | None = None,
+         baseline_path: str | None = None) -> dict:
+    """Programmatic entry point (tests, the tier-1 wiring).  Returns
+    the report dict (schema graftlint-report/1); report["ok"] is the
+    pass/fail verdict."""
+    selected = list(ALL_RULES)
+    if rules:
+        unknown = set(rules) - {r.name for r in ALL_RULES}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
+                             f"have {[r.name for r in ALL_RULES]}")
+        selected = [r for r in ALL_RULES if r.name in set(rules)]
+    ctx = Context(root, paths=paths)
+    if baseline_path is None:
+        baseline_path = DEFAULT_BASELINE if os.path.abspath(
+            root) == _REPO else None
+    return run_rules(ctx, selected, baseline_path=baseline_path)

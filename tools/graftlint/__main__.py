@@ -1,0 +1,62 @@
+###############################################################################
+# graftlint CLI: `python -m tools.graftlint [--json] [paths]`.
+# Exit 0 = clean (baselined findings are reported but don't fail),
+# exit 1 = active findings or baseline errors (stale/unjustified).
+###############################################################################
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    from tools import graftlint
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="project static-analysis suite "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: mpisppy_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine report (schema graftlint-report/1)")
+    ap.add_argument("--rules",
+                    help="comma-separated subset of rule names")
+    ap.add_argument("--baseline",
+                    help="baseline file (default: the committed "
+                         "tools/graftlint/baseline.json)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the tree this tool "
+                         "lives in)")
+    ap.add_argument("--list-rules", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.list_rules:
+        for r in graftlint.ALL_RULES:
+            print(f"{r.name:<16} {r.doc}")
+        return 0
+
+    root = ns.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    rules = ns.rules.split(",") if ns.rules else None
+    rep = graftlint.lint(root, paths=ns.paths or None, rules=rules,
+                         baseline_path=ns.baseline)
+    if ns.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        from tools.graftlint.core import Finding
+        for f in rep["findings"]:
+            print(Finding(**f).render())
+        for e in rep["errors"]:
+            print(f"ERROR: {e}")
+        n = rep["active"]
+        print(f"graftlint: {n} active finding(s), "
+              f"{rep['baselined']} baselined, "
+              f"{len(rep['errors'])} error(s) "
+              f"[rules: {', '.join(rep['rules'])}]")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

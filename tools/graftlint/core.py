@@ -1,0 +1,212 @@
+###############################################################################
+# graftlint core: Finding/Rule model, scan context, suppressions,
+# baseline round trip (ISSUE 10 tentpole; docs/static_analysis.md).
+#
+# The framework is deliberately boring: a Rule is a named callable over
+# a Context (repo root + cached sources/ASTs of the library files); it
+# returns Findings carrying file:line, a human message, and a STABLE
+# `key` — the identity the baseline matches on, so grandfathered
+# findings survive unrelated line drift.  Stdlib only: the lint must
+# run on a host with no jax (and inside tier-1 without importing the
+# library under scan — all analysis is AST/regex over source text; the
+# one exception is rules_schema_drift loading telemetry/{regress,
+# analyze}.py standalone BY PATH, which keeps "no import of the
+# package under scan" true while reusing the real metric flattener).
+#
+# Two escape hatches, both per-finding and both auditable:
+#   * inline suppression — `# graftlint: allow-<rule>` on the finding
+#     line (or the immediately preceding comment line);
+#   * the committed baseline (tools/graftlint/baseline.json) for
+#     grandfathered findings, matched by (rule, key).  Every entry
+#     MUST carry a non-empty `why` — a baseline without justification
+#     is itself a lint failure — and entries matching nothing are
+#     STALE failures, so the baseline can only shrink.
+###############################################################################
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+BASELINE_SCHEMA = "graftlint-baseline/1"
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*allow-([\w-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str           # stable identity for baseline matching
+    baselined: bool = False
+
+    def render(self) -> str:
+        tag = "  [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key,
+                "baselined": self.baselined}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str                     # one-line rule description (--list-rules)
+    run: object                  # Context -> list[Finding]
+
+
+class Context:
+    """One scan: repo root, the library files in scope, and parse
+    caches.  `paths` restricts the file set (CLI positional args);
+    repo-level rules (schema-drift, config-knob, readme-claims) always
+    read their anchor files relative to `root` regardless."""
+
+    def __init__(self, root: str, paths: list[str] | None = None,
+                 lib_dir: str = "mpisppy_tpu"):
+        self.root = os.path.abspath(root)
+        self.lib_dir = lib_dir
+        self._src: dict[str, str] = {}
+        self._lines: dict[str, list[str]] = {}
+        self._tree: dict[str, ast.AST] = {}
+        if paths:
+            files: list[str] = []
+            for p in paths:
+                ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+                if os.path.isdir(ap):
+                    files.extend(self._walk(ap))
+                elif ap.endswith(".py"):
+                    files.append(ap)
+            self.files = sorted({self.rel(f) for f in files})
+        else:
+            lib = os.path.join(self.root, lib_dir)
+            self.files = sorted(self.rel(f) for f in self._walk(lib))
+
+    @staticmethod
+    def _walk(top: str) -> list[str]:
+        out = []
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(os.path.join(dirpath, f) for f in filenames
+                       if f.endswith(".py"))
+        return out
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(os.path.abspath(path),
+                               self.root).replace(os.sep, "/")
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def source(self, rel: str) -> str:
+        if rel not in self._src:
+            with open(self.abspath(rel)) as f:
+                self._src[rel] = f.read()
+        return self._src[rel]
+
+    def lines(self, rel: str) -> list[str]:
+        if rel not in self._lines:
+            self._lines[rel] = self.source(rel).splitlines()
+        return self._lines[rel]
+
+    def tree(self, rel: str) -> ast.AST:
+        if rel not in self._tree:
+            self._tree[rel] = ast.parse(self.source(rel),
+                                        filename=rel)
+        return self._tree[rel]
+
+    # -- suppression -------------------------------------------------------
+    def suppressed(self, rel: str, line: int, rule: str) -> bool:
+        """True when `line` (1-based) carries `# graftlint: allow-<rule>`
+        or the immediately preceding line is a comment carrying it."""
+        lines = self.lines(rel)
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(lines):
+                m = SUPPRESS_RE.search(lines[ln - 1])
+                if m and m.group(1) == rule:
+                    if ln == line or lines[ln - 1].lstrip().startswith("#"):
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def load_baseline(path: str) -> tuple[dict[tuple[str, str], dict],
+                                      list[str]]:
+    """Returns ({(rule, key): entry}, errors).  A missing file is an
+    empty baseline; a malformed one (bad schema, entry without a
+    non-empty `why`) is reported as errors — the justification IS the
+    contract (ISSUE 10 acceptance)."""
+    if not os.path.exists(path):
+        return {}, []
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except ValueError as e:
+        return {}, [f"baseline {path}: unparseable JSON ({e})"]
+    if obj.get("schema") != BASELINE_SCHEMA:
+        errors.append(f"baseline {path}: schema "
+                      f"{obj.get('schema')!r} != {BASELINE_SCHEMA!r}")
+    entries: dict[tuple[str, str], dict] = {}
+    for i, e in enumerate(obj.get("entries", [])):
+        rule, key = e.get("rule"), e.get("key")
+        if not rule or not key:
+            errors.append(f"baseline entry {i}: needs rule+key")
+            continue
+        if not str(e.get("why", "")).strip():
+            errors.append(
+                f"baseline entry {rule}:{key}: missing `why` — every "
+                f"grandfathered finding needs a justification")
+        entries[(rule, key)] = e
+    return entries, errors
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[tuple[str, str], dict],
+                   ) -> tuple[list[Finding], list[str]]:
+    """Mark baselined findings; report stale entries (matched nothing)
+    as errors so the baseline can only shrink."""
+    out = []
+    hit: set[tuple[str, str]] = set()
+    for f in findings:
+        k = (f.rule, f.key)
+        if k in baseline:
+            hit.add(k)
+            f = dataclasses.replace(f, baselined=True)
+        out.append(f)
+    stale = [f"stale baseline entry {r}:{k} — the finding is gone; "
+             f"delete the entry" for (r, k) in sorted(set(baseline) - hit)]
+    return out, stale
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+def run_rules(ctx: Context, rules: list[Rule],
+              baseline_path: str | None = None) -> dict:
+    baseline, errors = load_baseline(baseline_path) \
+        if baseline_path else ({}, [])
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.run(ctx):
+            if not ctx.suppressed(f.path, f.line, f.rule):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.rule, f.path, f.line))
+    findings, stale = apply_baseline(findings, baseline)
+    errors.extend(stale)
+    active = [f for f in findings if not f.baselined]
+    return {
+        "schema": "graftlint-report/1",
+        "rules": [r.name for r in rules],
+        "findings": [f.to_dict() for f in findings],
+        "active": len(active),
+        "baselined": len(findings) - len(active),
+        "errors": errors,
+        "ok": not active and not errors,
+    }

@@ -1,0 +1,138 @@
+###############################################################################
+# config-knob: every `cfg.<name>` read in library code must be a
+# DECLARED knob, and knobs declared in utils/config.py's canned groups
+# that nothing ever reads are dead weight (they parse, they show in
+# --help, they do nothing — the worst kind of lie a CLI can tell).
+#
+# Declarations: literal first args of add_to_config / quick_assign /
+# add_and_assign anywhere in the library (utils/config.py canned
+# groups, the models' inparser_adders, confidence_config groups).
+#
+# Reads: `cfg.get("x")`, `cfg["x"]`, and `cfg.x` attribute access
+# (receivers whose source text ends in `cfg`; Config API method names
+# — parsed from the Config class itself — are excluded).  Because the
+# hub wiring reads knob blocks via literal name tuples
+# (`for key in ("checkpoint_path", ...): cfg.get(key)`), any string
+# literal in library code equal to a declared knob name also counts
+# as a READ REFERENCE for deadness purposes — the dead-knob check
+# therefore proves "no module outside utils/config.py even MENTIONS
+# the name", which is as close to unread as static analysis gets.
+#
+# An intentionally parse-only knob (a legacy alias kept so reference
+# scripts keep parsing) carries `# graftlint: allow-config-knob` on
+# its declaration line.
+###############################################################################
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "config-knob"
+
+_DECL_METHODS = {"add_to_config", "quick_assign", "add_and_assign"}
+
+
+def _config_api(ctx: Context) -> set[str]:
+    """Method names of the Config class (excluded from attribute-read
+    detection)."""
+    rel = f"{ctx.lib_dir}/utils/config.py"
+    api: set[str] = set()
+    try:
+        tree = ctx.tree(rel)
+    except (OSError, SyntaxError):
+        return api
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for b in node.body:
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    api.add(b.name)
+    return api
+
+
+def collect(ctx: Context):
+    """(declared: name -> [(rel, line)], utils_declared: name ->
+    (rel, line), reads: name -> [(rel, line)], mentions: set[str])."""
+    declared: dict[str, list] = {}
+    utils_declared: dict[str, tuple] = {}
+    reads: dict[str, list] = {}
+    literals: dict[str, list] = {}     # string literals outside config.py
+    api = _config_api(ctx)
+    cfg_rel = f"{ctx.lib_dir}/utils/config.py"
+    for rel in ctx.files:
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = ast.unparse(node.func.value)
+                is_cfg = recv.endswith("cfg") or recv in ("config", "self")
+                if attr in _DECL_METHODS and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                    declared.setdefault(name, []).append(
+                        (rel, node.lineno))
+                    if rel == cfg_rel:
+                        utils_declared.setdefault(
+                            name, (rel, node.lineno))
+                    continue
+                if attr == "get" and is_cfg and recv != "self" \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    reads.setdefault(node.args[0].value, []).append(
+                        (rel, node.lineno))
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str) \
+                    and ast.unparse(node.value).endswith("cfg"):
+                reads.setdefault(node.slice.value, []).append(
+                    (rel, node.lineno))
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "cfg" \
+                    and node.attr not in api \
+                    and not node.attr.startswith("_"):
+                reads.setdefault(node.attr, []).append((rel, node.lineno))
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) and rel != cfg_rel:
+                literals.setdefault(node.value, []).append(
+                    (rel, node.lineno))
+    return declared, utils_declared, reads, literals
+
+
+def run(ctx: Context) -> list[Finding]:
+    declared, utils_declared, reads, literals = collect(ctx)
+    out: list[Finding] = []
+    for name, sites in sorted(reads.items()):
+        if name in declared:
+            continue
+        for rel, line in sites:
+            out.append(Finding(
+                RULE_NAME, rel, line,
+                f"cfg read of undeclared knob {name!r} — declare it in "
+                f"a utils/config.py args group (argparse=False for "
+                f"programmatic-only knobs) so --help, defaults and "
+                f"this lint know it exists",
+                key=f"{rel}::undeclared::{name}"))
+    for name, (rel, line) in sorted(utils_declared.items()):
+        if name in reads or name in literals:
+            continue
+        out.append(Finding(
+            RULE_NAME, rel, line,
+            f"declared knob {name!r} is never read (no cfg.get/"
+            f"cfg[...]/attribute read, and no other module mentions "
+            f"the name) — dead CLI surface; delete it or mark an "
+            f"intentional parse-only alias with "
+            f"`# graftlint: allow-config-knob`",
+            key=f"dead::{name}"))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "undeclared cfg reads + dead (never-read) declared knobs",
+            run)

@@ -1,0 +1,122 @@
+###############################################################################
+# host-sync: device-to-host synchronization inside the ops/ hot path.
+#
+# `.item()`, `float()/int()/bool()` coercions, `np.asarray(...)` and
+# `.block_until_ready()` on a traced/device value force a blocking
+# device->host transfer.  Inside the ops/ kernels — the code the wheel
+# dispatches thousands of times per run — a stray sync serializes the
+# pipeline (and, under jit, raises TracerError at the worst possible
+# time: on the first caller who composes the op into a larger trace).
+#
+# Scope: the ITERATION KERNELS (pdhg, pdhg_pallas, simplex_qp) — the
+# modules whose bodies run inside the wheel's per-iteration dispatch,
+# where a stray sync serializes every restart window.  The rest of
+# ops/ is host-boundary by design and exempt: bnb.py is the host-side
+# B&B orchestrator (its np.asarray calls ARE the harvest), and
+# boxqp/cones/fbbt/sparse mix trace-pure kernels with problem
+# CONSTRUCTION and certificate RENDERING helpers that legitimately
+# materialize host values once per problem, not per iteration.
+# Legitimate syncs inside a hot module (the documented host seams,
+# e.g. pdhg.solve's auto-chunk loop reading st.k between capped
+# dispatches) carry an inline `# graftlint: allow-host-sync`.
+#
+# Coercion heuristic: float()/int()/bool() are flagged only when the
+# argument expression mentions a jnp/jax value or an attribute chain
+# (e.g. `int(st.k)`, `bool(jnp.all(...))`) — `int(opts.max_iters)` on
+# a plain Python options field is noise, and `float("inf")` /
+# `int(3)` literals never sync.
+###############################################################################
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "host-sync"
+
+#: ops/ modules that must stay pure-trace end to end; the rest of
+#: ops/ is host-boundary by design (see module header)
+HOT_MODULES = ("ops/pdhg.py", "ops/pdhg_pallas.py",
+               "ops/simplex_qp.py")
+
+_COERCIONS = {"float", "int", "bool"}
+
+
+def _mentions_device_value(node: ast.expr) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax", "lax"):
+            return True
+        if isinstance(sub, ast.Attribute):
+            return True
+    return False
+
+
+def _scan(ctx: Context, rel: str) -> list[Finding]:
+    out: list[Finding] = []
+    try:
+        tree = ctx.tree(rel)
+    except SyntaxError:
+        return out
+
+    # enclosing-function map: content-based baseline keys
+    # (fn::construct::occurrence), never raw line windows — a line
+    # bucket would let one grandfathered entry cover a FUTURE
+    # violation landing nearby
+    owner: dict[int, str] = {}
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    owner[id(sub)] = fn.name   # innermost wins (walk
+                    # order visits outer defs first, inner later)
+    counts: dict[tuple[str, str], int] = {}
+
+    def add(node, what, hint):
+        fn_name = owner.get(id(node), "<module>")
+        n = counts[(fn_name, what)] = counts.get((fn_name, what), 0) + 1
+        out.append(Finding(
+            RULE_NAME, rel, node.lineno,
+            f"{what} in a hot ops/ module forces a device->host sync "
+            f"({hint})",
+            key=f"{rel}::{fn_name}::{what}::{n}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                add(node, ".item()", "transfer + blocks the pipeline")
+            elif f.attr == "block_until_ready":
+                add(node, ".block_until_ready()",
+                    "blocks the dispatch pipeline")
+            elif f.attr == "asarray" and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy"):
+                add(node, "np.asarray(...)",
+                    "materializes the device value on host; use jnp "
+                    "inside kernels, or move the harvest to the "
+                    "orchestrator layer")
+        elif isinstance(f, ast.Name) and f.id in _COERCIONS \
+                and len(node.args) == 1 \
+                and _mentions_device_value(node.args[0]):
+            add(node, f"{f.id}(...) coercion",
+                "scalar coercion of a (likely) device value; keep it "
+                "an array, or mark the documented host seam with "
+                "`# graftlint: allow-host-sync`")
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    lib = ctx.lib_dir
+    targets = {f"{lib}/{m}" for m in HOT_MODULES}
+    for rel in ctx.files:
+        if rel in targets or any(rel.endswith("/" + m) or rel == m
+                                 for m in HOT_MODULES):
+            out.extend(_scan(ctx, rel))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "device->host syncs (.item/np.asarray/coercions) inside "
+            "pure-trace ops modules", run)

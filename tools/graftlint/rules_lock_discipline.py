@@ -1,0 +1,164 @@
+###############################################################################
+# lock-discipline: a lightweight race detector for the serving layer.
+#
+# PR 8 turned dispatch/scheduler.py into a ~900-line multithreaded
+# server with hand-rolled `self._lock` discipline and nothing checking
+# it.  This pass makes the discipline declarative: a shared field is
+# ANNOTATED at its __init__ assignment
+#
+#     self._batches = 0          # guarded-by: _lock
+#
+# and every later `self._batches` read/write must sit lexically inside
+# a `with self._lock:` block (or a lock-aliased condition — a field
+# built as `threading.Condition(self._lock)` shares its lock, so
+# `with self._wake:` also holds `_lock`).  Helper methods documented
+# as "caller holds the lock" declare it machine-readably on the def
+# line:
+#
+#     def _ensure_dispatcher(self):   # holds-lock: _lock
+#
+# Scope and soundness: analysis is lexical and per-class — it cannot
+# see a lock held across a call boundary without the holds-lock
+# marker, and it treats any access inside the right `with` as guarded
+# (no alias/escape analysis).  That is the useful trade: annotations
+# cost one comment per field, violations are almost always real (or
+# real documentation debt), and the pass forced a genuine audit of
+# every scheduler field when it landed (two lost-update races found —
+# see the ISSUE-10 commit).  __init__ (and __new__) are exempt:
+# construction happens-before publication.
+###############################################################################
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "lock-discipline"
+
+GUARDED_RE = re.compile(
+    r"self\.(\w+)\s*(?::[^=#]+)?=.*#\s*guarded-by:\s*(\w+)")
+HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\w+)")
+CTOR_EXEMPT = {"__init__", "__new__", "__post_init__"}
+
+
+def _with_locks(item: ast.withitem) -> str | None:
+    """`with self.<lock>:` -> lock name."""
+    e = item.context_expr
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return e.attr
+    return None
+
+
+def _class_annotations(ctx: Context, rel: str, cls: ast.ClassDef):
+    """(guarded: field -> lock, aliases: condvar field -> lock) from
+    the class body's source lines."""
+    lines = ctx.lines(rel)
+    end = max((n.end_lineno for n in ast.walk(cls)
+               if getattr(n, "end_lineno", None) is not None),
+              default=cls.lineno)
+    guarded: dict[str, str] = {}
+    for ln in range(cls.lineno, min(end, len(lines)) + 1):
+        m = GUARDED_RE.search(lines[ln - 1])
+        if m:
+            guarded[m.group(1)] = m.group(2)
+    aliases: dict[str, str] = {}
+    for node in ast.walk(cls):
+        # self._wake = threading.Condition(self._lock)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "Condition" and call.args:
+                a = call.args[0]
+                if isinstance(a, ast.Attribute) \
+                        and isinstance(a.value, ast.Name) \
+                        and a.value.id == "self":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            aliases[tgt.attr] = a.attr
+    return guarded, aliases
+
+
+def _check_method(ctx: Context, rel: str, cls_name: str,
+                  fn: ast.FunctionDef, guarded: dict[str, str],
+                  aliases: dict[str, str]) -> list[Finding]:
+    lines = ctx.lines(rel)
+    base_held: set[str] = set()
+    m = HOLDS_RE.search(lines[fn.lineno - 1])
+    if m:
+        base_held.add(m.group(1))
+    out: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+
+    def walk(node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, ast.With):
+            add = set()
+            for item in node.items:
+                lk = _with_locks(item)
+                if lk is not None:
+                    add.add(lk)
+                    if lk in aliases:
+                        add.add(aliases[lk])
+            for item in node.items:
+                walk(item, held)
+            inner = held | frozenset(add)
+            for b in node.body:
+                walk(b, inner)
+            return
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self" \
+                and node.attr in guarded:
+            lock = guarded[node.attr]
+            if lock not in held and (node.attr, node.lineno) not in seen:
+                seen.add((node.attr, node.lineno))
+                out.append(Finding(
+                    RULE_NAME, rel, node.lineno,
+                    f"{cls_name}.{node.attr} is `# guarded-by: {lock}` "
+                    f"but accessed in {fn.name}() outside `with "
+                    f"self.{lock}` (add the lock, or mark the def "
+                    f"`# holds-lock: {lock}` if the caller holds it)",
+                    key=f"{rel}::{cls_name}.{fn.name}::{node.attr}"))
+        # nested defs inherit nothing (they may run on another thread)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            for child in ast.iter_child_nodes(node):
+                walk(child, frozenset())
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    if fn.name in CTOR_EXEMPT:
+        return []
+    for stmt in fn.body:
+        walk(stmt, frozenset(base_held))
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for rel in ctx.files:
+        if "# guarded-by:" not in ctx.source(rel):
+            continue
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guarded, aliases = _class_annotations(ctx, rel, node)
+            if not guarded:
+                continue
+            for b in node.body:
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.extend(_check_method(ctx, rel, node.name, b,
+                                             guarded, aliases))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "`# guarded-by:` fields touched outside their lock "
+            "(threaded modules)", run)

@@ -1,0 +1,68 @@
+###############################################################################
+# no-print (graftlint pass 6; formerly tools/lint_no_print.py, ISSUE 3
+# satellite — tools/lint_no_print.py remains as a thin shim over this
+# module so existing invocations keep working).
+#
+# Library code must report through the telemetry console
+# (mpisppy_tpu.telemetry.console.log) so every human-readable line is
+# verbosity-filtered and lands in the JSONL trace; a bare `print(` is
+# invisible to both.  Allowed exceptions:
+#
+#   * the console/sink implementations themselves,
+#   * __main__ / dryrun entry points (their stdout IS the product),
+#   * lines carrying a `# telemetry: allow-print` marker — the CLI's
+#     machine-readable JSON result protocol on stdout/stderr
+#     (the graftlint-native `# graftlint: allow-no-print` works too).
+###############################################################################
+from __future__ import annotations
+
+import re
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "no-print"
+
+ALLOWED_FILES = {
+    "telemetry/console.py",   # the console sink of last resort
+    "telemetry/sinks.py",     # ConsoleSink rendering
+    "telemetry/__main__.py",  # trace-toolbox CLI (its stdout IS the
+                              # product: reports + JSON)
+    "telemetry/watch.py",     # live-monitor renderer (stdout IS the
+                              # product: the refreshing status block)
+    "__main__.py",            # CLI entry point
+    "parallel/_multihost_dryrun.py",  # multihost smoke entry point
+    "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
+    "resilience/watchdog.py",  # abort-path last words go straight to
+                               # stderr: the telemetry console may be
+                               # wedged inside the very stall the
+                               # watchdog is escaping (ISSUE 9)
+}
+
+MARKER = "telemetry: allow-print"
+PRINT_RE = re.compile(r"(?<![\w.])print\(")
+
+
+def run(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    prefix = ctx.lib_dir + "/"
+    for rel in ctx.files:
+        short = rel[len(prefix):] if rel.startswith(prefix) else rel
+        if short in ALLOWED_FILES:
+            continue
+        for lineno, line in enumerate(ctx.lines(rel), 1):
+            # match only the code portion: a print( mentioned in a
+            # comment (or the allow marker itself) is fine
+            code = line.split("#", 1)[0]
+            if PRINT_RE.search(code) and MARKER not in line:
+                out.append(Finding(
+                    RULE_NAME, rel, lineno,
+                    f"bare print( — use mpisppy_tpu.telemetry.console"
+                    f".log (or add `# {MARKER}` for CLI protocol "
+                    f"output)",
+                    key=f"{rel}::{lineno}"))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "bare print( in library code (route through the "
+            "telemetry console)", run)

@@ -1,0 +1,218 @@
+###############################################################################
+# readme-claims (graftlint pass 7; formerly tools/check_readme_claims.py
+# — which remains as a thin shim over this module).  ISSUE 5 satellite;
+# VERDICT r5 item: "README numbers drift from the driver-captured
+# artifacts".
+#
+# Every performance number quoted in README's measured-results section
+# (the block opening with "Measured on" and closing at "Out of scope")
+# must trace to a committed benchmark artifact: a numeric field of
+# BENCH_DETAIL.json, DEVICE_PROFILE.json (trace-derived device
+# profiles, ISSUE 7) or any BENCH_r0N.json (including numbers inside a
+# wrapper's possibly-truncated stdout `tail`).  "Performance number"
+# means a number carrying a perf unit — seconds, x-factors, percents,
+# iterations, iters/s, TFLOPs, GB/s; config numbers ("900 scenarios",
+# "3-stage") are not claims and are ignored.
+#
+# Matching is display-precision aware: a README "102.7 s" traces to an
+# artifact 102.66 (round-to-shown-digits), a "0.99%" to a 0.009910
+# rel_gap (percent <-> fraction), and a "~" prefix marks an
+# approximation allowed APPROX_REL_TOL relative slack.  Numbers with
+# no artifact witness are violations: the artifacts are the evidence,
+# the README quotes them — never better local runs.
+#
+# Second check (ISSUE 8): every measured-section bullet quoting a
+# solver-throughput claim (seconds-to-gap, sec/iter, iters/s) must
+# disclose the iteration-precision mode it was measured at
+# (docs/precision.md) — bf16x3 halves the per-matvec byte traffic, so
+# a throughput number without its mode is not a reproducible claim.
+###############################################################################
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "readme-claims"
+
+SECTION_START = "Measured on"
+SECTION_END = "Out of scope"
+
+#: perf units that make a number a checkable claim (longest first so
+#: "iters/s" wins over a bare "s")
+UNITS = ("iters/s", "iterations", "seconds", "TFLOPs", "TFLOP",
+         "GB/s", "sec", "%", "x", "s")
+CLAIM_RE = re.compile(
+    r"(~?)(-?\d+(?:\.\d+)?)\s*(" + "|".join(
+        re.escape(u) + (r"\b" if u[-1].isalnum() else "")
+        for u in UNITS) + r")")
+
+NUM_RE = re.compile(r"-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?")
+
+APPROX_REL_TOL = 0.10   # slack granted to "~"-marked approximations
+
+PRECISION_TOKENS = ("bf16x3", "bf16x6", "full precision")
+SPEED_UNITS = {"s", "sec", "seconds", "iters/s"}
+
+
+def _collect_numbers(obj, pool: set) -> None:
+    """Every number in a JSON artifact — including numbers embedded in
+    string values (bench notes, truncated stdout tails)."""
+    if isinstance(obj, bool):
+        return
+    if isinstance(obj, (int, float)):
+        pool.add(float(obj))
+    elif isinstance(obj, str):
+        for m in NUM_RE.finditer(obj):
+            try:
+                pool.add(float(m.group()))
+            except ValueError:
+                pass
+    elif isinstance(obj, list):
+        for v in obj:
+            _collect_numbers(v, pool)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_numbers(v, pool)
+        # derived witnesses: the speedup-vs-baseline factor a README
+        # naturally quotes next to a to-gap phase ("~1.8x faster")
+        if isinstance(obj.get("seconds_to_gap"), (int, float)):
+            for base_key in ("baseline_64rank_sec", "baseline_1rank_sec"):
+                base = obj.get(base_key)
+                if isinstance(base, (int, float)) \
+                        and obj["seconds_to_gap"]:
+                    pool.add(base / obj["seconds_to_gap"])
+
+
+def artifact_pool(repo: str) -> set:
+    pool: set = set()
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json")))
+    for extra in ("BENCH_DETAIL.json", "DEVICE_PROFILE.json"):
+        p = os.path.join(repo, extra)
+        if os.path.exists(p):
+            paths.append(p)
+    for p in paths:
+        try:
+            with open(p) as f:
+                _collect_numbers(json.load(f), pool)
+        except (OSError, ValueError):
+            continue
+    return pool
+
+
+def _measured_section(text: str) -> list[tuple[int, str]]:
+    """The measured-results block's (lineno, line) pairs — THE one
+    slicing rule both sub-checks scan, so they can never drift onto
+    different sections."""
+    lines = text.splitlines()
+    start = next((i for i, ln in enumerate(lines)
+                  if SECTION_START in ln), None)
+    if start is None:
+        return []
+    end = next((i for i in range(start + 1, len(lines))
+                if lines[i].startswith(SECTION_END)), len(lines))
+    return [(i + 1, lines[i]) for i in range(start, end)]
+
+
+def claims_in(text: str) -> list[tuple[str, float, int, str, int]]:
+    """(display, value, decimals, unit, lineno) perf claims in the
+    measured section; `display` keeps the ~ marker."""
+    out = []
+    for lineno, ln in _measured_section(text):
+        for m in CLAIM_RE.finditer(ln):
+            approx, num, unit = m.group(1), m.group(2), m.group(3)
+            decimals = len(num.split(".")[1]) if "." in num else 0
+            out.append((approx + num + unit, float(num), decimals, unit,
+                        lineno))
+    return out
+
+
+def undisclosed_precision_bullets(text: str) -> list[tuple[int, str]]:
+    """(lineno, head) of measured-section bullets carrying a
+    speed-unit claim but no precision-mode token.  Bullets are grouped
+    ('- ' starts one; indented lines continue it) so a disclosure
+    anywhere in the bullet covers its wrapped lines."""
+    bullets: list[tuple[int, str]] = []
+    cur: tuple[int, str] | None = None
+    for lineno, ln in _measured_section(text):
+        if ln.lstrip().startswith("- "):
+            if cur is not None:
+                bullets.append(cur)
+            cur = (lineno, ln)
+        elif cur is not None and ln[:1] in (" ", "\t") and ln.strip():
+            cur = (cur[0], cur[1] + "\n" + ln)
+        elif cur is not None:
+            # blank line or unindented prose ends the bullet — trailing
+            # section paragraphs must not donate their disclosure token
+            bullets.append(cur)
+            cur = None
+    if cur is not None:
+        bullets.append(cur)
+    bad = []
+    for lineno, b in bullets:
+        has_speed = any(m.group(3) in SPEED_UNITS
+                        for m in CLAIM_RE.finditer(b))
+        disclosed = any(tok in b.lower() for tok in PRECISION_TOKENS)
+        if has_speed and not disclosed:
+            bad.append((lineno, b.strip().splitlines()[0]))
+    return bad
+
+
+def _matches(value: float, decimals: int, approx: bool, unit: str,
+             pool: set) -> bool:
+    tol = 0.5 * 10.0 ** (-decimals)
+    for v in pool:
+        cands = (v, v * 100.0) if unit == "%" else (v,)
+        for c in cands:
+            if abs(value - c) <= tol:
+                return True
+            if approx and c and abs(value - c) <= APPROX_REL_TOL * abs(c):
+                return True
+    return False
+
+
+def check_readme(readme_path: str, pool: set) -> list[Finding]:
+    rel = os.path.basename(readme_path)
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    seen = set()
+    out: list[Finding] = []
+    for display, value, decimals, unit, lineno in claims_in(text):
+        if display in seen:
+            continue
+        seen.add(display)
+        if not _matches(value, decimals, display.startswith("~"), unit,
+                        pool):
+            out.append(Finding(
+                RULE_NAME, rel, lineno,
+                f"perf claim {display!r} has no witness in "
+                f"BENCH_DETAIL.json / BENCH_r0*.json / "
+                f"DEVICE_PROFILE.json — quote the committed artifact, "
+                f"not a local run",
+                key=f"claim::{display}"))
+    for lineno, head in undisclosed_precision_bullets(text):
+        out.append(Finding(
+            RULE_NAME, rel, lineno,
+            f"throughput claim without an iteration-precision "
+            f"disclosure (need one of {PRECISION_TOKENS} in the "
+            f"bullet; docs/precision.md): {head[:80]!r}",
+            key=f"precision::{head[:60]}"))
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    readme = os.path.join(ctx.root, "README.md")
+    if not os.path.exists(readme):
+        return []
+    return check_readme(readme, artifact_pool(ctx.root))
+
+
+RULE = Rule(RULE_NAME,
+            "README measured-section perf numbers must trace to "
+            "committed BENCH artifacts (+ precision disclosure)", run)

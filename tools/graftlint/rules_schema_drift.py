@@ -1,0 +1,340 @@
+###############################################################################
+# schema-drift: the telemetry taxonomy is kept consistent by machine,
+# not by reviewer memory.  Four sub-checks, one rule:
+#
+#   1. EMIT KINDS — every event kind emitted anywhere in the library
+#      (`bus.emit("...")`, `self._emit(tel.X, ...)`,
+#      `self._emit_event("...", ...)`) must be declared in
+#      telemetry/events.py (the uppercase string constants whose union
+#      is ALL_KINDS).  A typo'd kind silently fragments the trace —
+#      sinks store it, the analyzer drops it.
+#   2. DOC ROWS — every declared kind must have a row in
+#      docs/telemetry.md's event table, and every backticked kind in
+#      the table must still be declared (both drift directions).
+#   3. METRICS — every literal metric name at a
+#      REGISTRY.inc/set_gauge/set_counter/get call site must be
+#      declared in telemetry/metrics.py ALL_METRICS (the registry this
+#      pass forced into existence).  Names passed as variables are
+#      skipped (documented approximation — the declared registry still
+#      anchors them for humans).
+#   4. GATE KEYS — every GATES/MILESTONES pattern in
+#      telemetry/regress.py must match at least one metric key
+#      produced by a COMMITTED artifact: the BENCH_r0*/BENCH_DETAIL/
+#      DEVICE_PROFILE/SSLP_CERT JSON files plus analyzer reports
+#      derived from the committed tests/fixtures/golden_*.jsonl
+#      traces.  A gate nothing can produce is dead armor — it looks
+#      like protection and gates nothing.
+#
+# Events/metrics declarations are read by AST (no import of the
+# package under scan); the gate-key check loads telemetry/regress.py
+# and analyze.py standalone BY PATH (stdlib-only modules) so the key
+# flattening can never drift from the real gate's.
+###############################################################################
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+import sys
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "schema-drift"
+
+
+# -- declared vocabularies (AST, no imports) --------------------------------
+def declared_kinds(ctx: Context):
+    """(kind -> lineno, events.py relpath, CONST name -> kind), or
+    None when the scanned tree has no events module."""
+    rel = f"{ctx.lib_dir}/telemetry/events.py"
+    if not os.path.exists(ctx.abspath(rel)):
+        return None
+    kinds: dict[str, int] = {}
+    consts: dict[str, str] = {}
+    for node in ctx.tree(rel).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+            kinds[node.value.value] = node.lineno
+    return kinds, rel, consts
+
+
+def declared_metrics(ctx: Context):
+    rel = f"{ctx.lib_dir}/telemetry/metrics.py"
+    if not os.path.exists(ctx.abspath(rel)):
+        return None, rel
+    for node in ast.walk(ctx.tree(rel)):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "ALL_METRICS":
+            names = set()
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    names.add(sub.value)
+            return names, rel
+    return None, rel
+
+
+# -- call-site extraction ---------------------------------------------------
+_EMIT_WRAPPER_NAMES = {"_emit", "_emit_event"}
+_METRIC_METHODS = {"inc", "set_gauge", "set_counter"}
+
+
+def _forwarding_wrappers(tree: ast.AST) -> set[str]:
+    """Module-local wrapper names whose FIRST parameter is forwarded
+    verbatim as the kind of an inner `.emit(...)` call (hub._emit,
+    scheduler._emit_event).  A wrapper whose first param is NOT the
+    kind (profiler._emit forwards `action` into the data payload of a
+    fixed ev.PROFILE) is excluded — its call sites are not kind
+    sites."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in _EMIT_WRAPPER_NAMES):
+            continue
+        params = [a.arg for a in node.args.args if a.arg != "self"]
+        if not params:
+            continue
+        p0 = params[0]
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "emit" and sub.args \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id == p0:
+                out.add(node.name)
+    return out
+
+
+def _emitted_kinds(ctx: Context, consts: dict[str, str]):
+    """[(rel, line, kind, resolved)] for every emit call site with a
+    statically-known kind.  `tel.X` / `ev.X` attribute kinds resolve
+    through the events-module constants; an attribute that does NOT
+    resolve is reported with resolved=False (a constant that was
+    deleted but is still referenced would crash at import — caught
+    earlier — so in practice this means a non-events alias)."""
+    sites = []
+    for rel in ctx.files:
+        if rel.endswith("telemetry/events.py"):
+            continue
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        wrappers = {"emit"} | _forwarding_wrappers(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in wrappers
+                    and node.args):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                sites.append((rel, node.lineno, a0.value, True))
+            elif isinstance(a0, ast.Attribute) \
+                    and isinstance(a0.value, ast.Name) \
+                    and a0.value.id in ("tel", "ev", "events"):
+                kind = consts.get(a0.attr)
+                sites.append((rel, node.lineno,
+                              kind if kind is not None else a0.attr,
+                              kind is not None))
+    return sites
+
+
+def _metric_sites(ctx: Context):
+    sites = []
+    for rel in ctx.files:
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _METRIC_METHODS and node.args:
+                recv = ast.unparse(node.func.value)
+                if not (recv.endswith("REGISTRY") or recv == "R"
+                        or recv.endswith("registry")):
+                    continue
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) \
+                        and isinstance(a0.value, str):
+                    sites.append((rel, node.lineno, a0.value))
+    return sites
+
+
+# -- doc table --------------------------------------------------------------
+def doc_table_kinds(ctx: Context, doc_rel: str = "docs/telemetry.md"):
+    """Backticked kinds in the first cell of the event-table rows.
+    Combined rows (`run-start`/`run-end`) contribute each kind."""
+    path = ctx.abspath(doc_rel)
+    if not os.path.exists(path):
+        return None
+    kinds: dict[str, int] = {}
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            if not line.startswith("|"):
+                continue
+            first = line.split("|")[1]
+            for m in re.finditer(r"`([\w-]+)`", first):
+                kinds.setdefault(m.group(1), ln)
+    return kinds
+
+
+# -- gate-key resolution ----------------------------------------------------
+def _load_by_path(ctx: Context, rel: str, name: str):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        f"_graftlint_{name}", ctx.abspath(rel))
+    mod = importlib.util.module_from_spec(spec)
+    prev = sys.modules.get(spec.name)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        if prev is not None:
+            sys.modules[spec.name] = prev
+        else:
+            sys.modules.pop(spec.name, None)
+    return mod
+
+
+def committed_key_pool(ctx: Context, regress) -> set[str]:
+    pool: set[str] = set()
+    for pat in ("BENCH_r0*.json", "BENCH_DETAIL.json",
+                "DEVICE_PROFILE.json", "SSLP_CERT.json"):
+        for p in sorted(glob.glob(os.path.join(ctx.root, pat))):
+            try:
+                pool |= set(regress.extract_metrics(
+                    regress.load_artifact(p)))
+            except (OSError, ValueError):
+                continue
+    # analyzer reports over the committed golden trace fixtures:
+    # analyze.py imports sibling telemetry modules via the package —
+    # load through the package only if importable from ctx.root,
+    # else skip (a stripped test repo still lints its own artifacts)
+    fixtures = sorted(glob.glob(os.path.join(
+        ctx.root, "tests", "fixtures", "golden_*.jsonl")))
+    if fixtures:
+        try:
+            sys.path.insert(0, ctx.root)
+            from importlib import import_module
+            an = import_module(f"{ctx.lib_dir}.telemetry.analyze")
+            for fx in fixtures:
+                try:
+                    pool |= set(regress.extract_metrics(
+                        an.analyze_path(fx)))
+                except Exception:
+                    continue
+        except Exception:
+            pass
+        finally:
+            if sys.path and sys.path[0] == ctx.root:
+                sys.path.pop(0)
+    return pool
+
+
+def run(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    ev = declared_kinds(ctx)
+    if ev is None:
+        return out      # not a repo with a telemetry spine: nothing to do
+    kinds, ev_rel, consts = ev
+
+    # 1. emitted kinds must be declared
+    for rel, line, kind, resolved in _emitted_kinds(ctx, consts):
+        if not resolved:
+            out.append(Finding(
+                RULE_NAME, rel, line,
+                f"event kind attribute `{kind}` does not resolve "
+                f"against {ev_rel} constants",
+                key=f"{rel}::emit-unresolved::{kind}"))
+        elif kind not in kinds:
+            out.append(Finding(
+                RULE_NAME, rel, line,
+                f"emitted event kind {kind!r} is not declared in "
+                f"{ev_rel} (ALL_KINDS) — a typo'd kind fragments the "
+                f"trace silently",
+                key=f"{rel}::emit::{kind}"))
+
+    # 2. declared kinds <-> doc table rows
+    doc = doc_table_kinds(ctx)
+    if doc is not None:
+        for kind, line in sorted(kinds.items()):
+            if kind not in doc:
+                out.append(Finding(
+                    RULE_NAME, ev_rel, line,
+                    f"event kind {kind!r} has no row in "
+                    f"docs/telemetry.md's event table",
+                    key=f"doc-missing::{kind}"))
+        for kind, line in sorted(doc.items()):
+            if kind not in kinds and "-" in kind:
+                # hyphenless backticked tokens in the table are field
+                # names, not kinds; every real kind is hyphenated
+                # except the declared ones checked above
+                if kind in ("flight-recorder",):
+                    continue    # dump-file-only header kind (flightrec)
+                out.append(Finding(
+                    RULE_NAME, "docs/telemetry.md", line,
+                    f"doc event-table row {kind!r} has no declared "
+                    f"kind in {ev_rel}",
+                    key=f"doc-stale::{kind}"))
+
+    # 3. metric literals must be registered
+    metrics, m_rel = declared_metrics(ctx)
+    if metrics is None:
+        out.append(Finding(
+            RULE_NAME, m_rel, 1,
+            "telemetry/metrics.py declares no ALL_METRICS registry — "
+            "metric names have no schema to drift against",
+            key="no-metric-registry"))
+    else:
+        for rel, line, name in _metric_sites(ctx):
+            if name not in metrics:
+                out.append(Finding(
+                    RULE_NAME, rel, line,
+                    f"metric {name!r} is not declared in {m_rel} "
+                    f"ALL_METRICS",
+                    key=f"{rel}::metric::{name}"))
+
+    # 4. GATES/MILESTONES must resolve against committed artifacts
+    reg_rel = f"{ctx.lib_dir}/telemetry/regress.py"
+    if os.path.exists(ctx.abspath(reg_rel)):
+        try:
+            regress = _load_by_path(ctx, reg_rel, "regress")
+        except Exception as e:   # unparseable regress: surface, move on
+            out.append(Finding(RULE_NAME, reg_rel, 1,
+                               f"could not load regress.py: {e}",
+                               key="regress-unloadable"))
+            return out
+        pool = committed_key_pool(ctx, regress)
+        if pool:
+            tables = [("GATES", getattr(regress, "GATES", ())),
+                      ("MILESTONES", getattr(regress, "MILESTONES", ()))]
+            src = ctx.source(reg_rel)
+            for table, rows in tables:
+                for pat, _direction, _thr in rows:
+                    if any(re.search(pat, k) for k in pool):
+                        continue
+                    line = next((i for i, ln in enumerate(
+                        src.splitlines(), 1) if pat in ln
+                        or pat.replace("\\", "") in ln), 1)
+                    out.append(Finding(
+                        RULE_NAME, reg_rel, line,
+                        f"{table} pattern {pat!r} matches no metric "
+                        f"key of any committed artifact (BENCH_*/"
+                        f"DEVICE_PROFILE/SSLP_CERT/golden-trace "
+                        f"analyzer report) — a gate nothing produces "
+                        f"gates nothing",
+                        key=f"gate-unresolved::{pat}"))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "event kinds vs ALL_KINDS vs docs table; metric names vs "
+            "ALL_METRICS; GATES/MILESTONES vs committed artifacts", run)

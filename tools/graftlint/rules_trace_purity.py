@@ -1,0 +1,205 @@
+###############################################################################
+# trace-purity: the PR-4 recompile-leak class, caught at lint time.
+#
+# `lax.fori_loop`/`while_loop`/`scan`/`cond`/`switch` called EAGERLY
+# (outside any jit trace) traces its body with every closed-over array
+# baked in as a jaxpr CONSTANT — XLA compiles a fresh loop executable
+# per distinct operand VALUES, one silent backend compile per call.
+# That is exactly the pair of leaks the runtime compile-guard found
+# after PR 4 shipped (ops/pdhg.estimate_norm, ops/bnb._solve_node);
+# this pass flags the whole class before runtime.
+#
+# Analysis (per module, AST only — documented approximation):
+#   * a function is JIT-PROTECTED when it is decorated with jax.jit /
+#     partial(jax.jit, ...) / pl.pallas_call-style kernels, when its
+#     name contains "_jit" (the repo convention for trace-only
+#     helpers), or when it is nested inside a protected function;
+#   * a PRIVATE top-level function (leading underscore) inherits
+#     protection when every intra-module caller is protected (fixed
+#     point over the module call graph) — e.g. simplex_qp._estimate_L
+#     is only reachable through the jitted solve_simplex_qp;
+#   * a lax control-flow call site whose outermost enclosing function
+#     is unprotected (or that sits at module level) is a finding.
+#     Public functions are assumed host-callable: an eager entry point
+#     that owns a lax loop must either jit it (shape-keyed) or carry a
+#     justification (inline allow or baseline entry).
+#
+# Second check, same bug family: `jax.jit(<lambda or local def>)`
+# CONSTRUCTED inside a function body builds a fresh jitted callable —
+# and a fresh compile cache — per call; the jit cache keys on the
+# wrapper object, so every invocation recompiles.  Module-level /
+# decorator jits are fine.
+###############################################################################
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Context, Finding, Rule
+
+RULE_NAME = "trace-purity"
+CONTROL_FLOW = {"fori_loop", "while_loop", "scan", "cond", "switch"}
+
+_JIT_DEC_RE = re.compile(r"(^|[.(\s])jit\b")
+
+
+def _dec_is_jit(dec: ast.expr) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit)."""
+    return bool(_JIT_DEC_RE.search(ast.unparse(dec)))
+
+
+def _is_lax_cf(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in CONTROL_FLOW:
+        chain = ast.unparse(f.value)
+        if chain.endswith("lax"):
+            return f.attr
+    return None
+
+
+class _FnInfo:
+    __slots__ = ("name", "node", "protected", "private", "calls",
+                 "cf_sites", "jit_closures", "cls")
+
+    def __init__(self, name, node, cls: str | None = None):
+        self.name = name
+        self.node = node
+        self.cls = cls                     # owning class (methods)
+        self.protected = False
+        self.private = name.split(".")[-1].startswith("_")
+        self.calls: set[str] = set()       # referenced callable names
+        self.cf_sites: list[tuple[int, str]] = []
+        self.jit_closures: list[tuple[int, str]] = []
+
+
+def _analyze_module(tree: ast.Module):
+    """Top-level function table + module-level control-flow sites."""
+    fns: dict[str, _FnInfo] = {}
+    module_sites: list[tuple[int, str]] = []
+
+    def scan_body(fn: _FnInfo | None, node: ast.AST,
+                  protected: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_protected = protected \
+                    or any(_dec_is_jit(d) for d in child.decorator_list) \
+                    or "_jit" in child.name
+                scan_body(fn, child, child_protected)
+                continue
+            if isinstance(child, ast.Call):
+                kind = _is_lax_cf(child)
+                if kind is not None and not protected:
+                    site = (child.lineno, kind)
+                    (fn.cf_sites if fn else module_sites).append(site)
+                # jit(<lambda/local def>) built inside a function body
+                if fn is not None:
+                    ftxt = ast.unparse(child.func)
+                    if ftxt.endswith("jit") and child.args and isinstance(
+                            child.args[0], ast.Lambda):
+                        fn.jit_closures.append(
+                            (child.lineno, "jit(lambda)"))
+            if isinstance(child, ast.Name) and fn is not None:
+                fn.calls.add(child.id)
+            # self._helper(...) references register class-qualified so
+            # the protection fixed point also covers private METHODS
+            # reachable only through a jitted sibling method
+            if isinstance(child, ast.Attribute) and fn is not None \
+                    and fn.cls is not None \
+                    and isinstance(child.value, ast.Name) \
+                    and child.value.id == "self":
+                fn.calls.add(f"{fn.cls}.{child.attr}")
+            scan_body(fn, child, protected)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _FnInfo(node.name, node)
+            info.protected = any(_dec_is_jit(d)
+                                 for d in node.decorator_list) \
+                or "_jit" in node.name
+            fns[node.name] = info
+        elif isinstance(node, ast.ClassDef):
+            # methods: treated like top-level functions qualified by
+            # class (no cross-class call-graph; jit decoration and
+            # _jit naming still protect, and self.-calls feed the
+            # fixed point above)
+            for b in node.body:
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = _FnInfo(f"{node.name}.{b.name}", b,
+                                   cls=node.name)
+                    info.protected = any(_dec_is_jit(d)
+                                         for d in b.decorator_list) \
+                        or "_jit" in b.name
+                    fns[info.name] = info
+
+    for info in fns.values():
+        scan_body(info, info.node, info.protected)
+    # module-level statements (outside any def)
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    kind = _is_lax_cf(sub)
+                    if kind is not None:
+                        module_sites.append((sub.lineno, kind))
+
+    # fixed point: a private function whose every intra-module caller
+    # is protected inherits protection
+    callers: dict[str, set[str]] = {n: set() for n in fns}
+    for name, info in fns.items():
+        for callee in info.calls:
+            if callee in fns:
+                callers[callee].add(name)
+    changed = True
+    while changed:
+        changed = False
+        for name, info in fns.items():
+            if info.protected or not info.private:
+                continue
+            cs = callers[name] - {name}
+            if cs and all(fns[c].protected for c in cs):
+                info.protected = True
+                changed = True
+    return fns, module_sites
+
+
+def run(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for rel in ctx.files:
+        try:
+            tree = ctx.tree(rel)
+        except SyntaxError:
+            continue
+        fns, module_sites = _analyze_module(tree)
+        for line, kind in module_sites:
+            out.append(Finding(
+                RULE_NAME, rel, line,
+                f"eager lax.{kind} at module level — traces with "
+                f"operand values baked in; wrap in a jitted function",
+                key=f"{rel}::<module>::{kind}"))
+        for info in fns.values():
+            if info.protected:
+                continue
+            for line, kind in info.cf_sites:
+                out.append(Finding(
+                    RULE_NAME, rel, line,
+                    f"lax.{kind} reachable eagerly via {info.name}() — "
+                    f"closed-over arrays become jaxpr constants and "
+                    f"every distinct input VALUE recompiles (the PR-4 "
+                    f"leak class); jit the call site shape-keyed "
+                    f"(@jax.jit or a *_jit helper)",
+                    key=f"{rel}::{info.name}::{kind}"))
+            for line, what in info.jit_closures:
+                out.append(Finding(
+                    RULE_NAME, rel, line,
+                    f"{what} constructed per call inside {info.name}() "
+                    f"— a fresh jit wrapper (and compile-cache entry) "
+                    f"every invocation; hoist the jitted callable to "
+                    f"module scope",
+                    key=f"{rel}::{info.name}::{what}"))
+    return out
+
+
+RULE = Rule(RULE_NAME,
+            "eager lax control flow / per-call jit wrappers "
+            "(recompile-leak class)", run)

@@ -1,70 +1,42 @@
 #!/usr/bin/env python
 ###############################################################################
-# No-bare-print lint (ISSUE 3 satellite; enforced in tier-1 by
-# tests/test_telemetry.py::test_no_bare_prints_in_library_code).
-#
-# Library code must report through the telemetry console
-# (mpisppy_tpu.telemetry.console.log) so every human-readable line is
-# verbosity-filtered and lands in the JSONL trace; a bare `print(` is
-# invisible to both.  Allowed exceptions:
-#
-#   * the console/sink implementations themselves,
-#   * __main__ / dryrun entry points (their stdout IS the product),
-#   * lines carrying a `# telemetry: allow-print` marker — the CLI's
-#     machine-readable JSON result protocol on stdout/stderr.
+# No-bare-print lint — THIN SHIM over the graftlint no-print pass
+# (ISSUE 10: `python -m tools.graftlint` is the real runner; this
+# entry point and its find_violations() surface are preserved for the
+# existing tier-1 wiring and muscle memory).  Rule doc, allowlist and
+# marker live in tools/graftlint/rules_no_print.py.
 ###############################################################################
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-LIB_ROOT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "mpisppy_tpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-ALLOWED_FILES = {
-    "telemetry/console.py",   # the console sink of last resort
-    "telemetry/sinks.py",     # ConsoleSink rendering
-    "telemetry/__main__.py",  # trace-toolbox CLI (its stdout IS the
-                              # product: reports + JSON)
-    "telemetry/watch.py",     # live-monitor renderer (stdout IS the
-                              # product: the refreshing status block)
-    "__main__.py",            # CLI entry point
-    "parallel/_multihost_dryrun.py",  # multihost smoke entry point
-    "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
-    "resilience/watchdog.py",  # abort-path last words go straight to
-                               # stderr: the telemetry console may be
-                               # wedged inside the very stall the
-                               # watchdog is escaping (ISSUE 9)
-}
+from tools.graftlint.core import Context  # noqa: E402
+from tools.graftlint.rules_no_print import (  # noqa: E402,F401
+    ALLOWED_FILES, MARKER, PRINT_RE, RULE,
+)
 
-MARKER = "telemetry: allow-print"
-PRINT_RE = re.compile(r"(?<![\w.])print\(")
+LIB_ROOT = os.path.join(_REPO, "mpisppy_tpu")
 
 
 def find_violations(root: str = LIB_ROOT) -> list[str]:
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            if rel in ALLOWED_FILES:
-                continue
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    # match only the code portion: a print( mentioned in
-                    # a comment (or the allow marker itself) is fine
-                    code = line.split("#", 1)[0]
-                    if PRINT_RE.search(code) and MARKER not in line:
-                        violations.append(
-                            f"{rel}:{lineno}: bare print( — use "
-                            f"mpisppy_tpu.telemetry.console.log "
-                            f"(or add `# {MARKER}` for CLI protocol "
-                            f"output)")
-    return violations
+    """Back-compat surface: violation strings, same format as the
+    pre-graftlint tool (rel-to-lib paths)."""
+    repo = os.path.dirname(root)
+    ctx = Context(repo, paths=[root],
+                  lib_dir=os.path.basename(root))
+    out = []
+    for f in RULE.run(ctx):
+        if ctx.suppressed(f.path, f.line, f.rule):
+            continue
+        rel = os.path.relpath(os.path.join(repo, f.path),
+                              root).replace(os.sep, "/")
+        out.append(f"{rel}:{f.line}: {f.message}")
+    return out
 
 
 def main() -> int:
