@@ -415,53 +415,29 @@ def bench_sweep_one(S):
         return {"scenarios": S, "error": repr(e)}
 
 
-def bench_wheel_overhead():
-    """Wheel overhead: per-iteration wall-clock of a full hub + 4-bound
-    wheel vs bare PH on the same batch.  Round 3 measured 642x with
-    every spoke a separate to-convergence device dispatch; the fused
-    wheel (algos.fused_wheel — Lagrangian + xhat-xbar + slam + shuffle
-    planes INSIDE the hub's jitted step, fixed warm budgets) is the
-    round-4 answer.  Target: overhead factor <= 5x."""
-    import jax
-    import jax.numpy as jnp
-
-    from mpisppy_tpu.algos import fused_wheel as fw
+def _overhead_ph_opts(n_iters):
+    """The PH config BOTH wheel_overhead phases run — one builder (with
+    _overhead_wheel_options/_overhead_spokes/_bare_ph_sec_per_iter) so
+    the async phase stays an apples-to-apples A/B against the
+    synchronous baseline its gated overhead_factor is compared to."""
     from mpisppy_tpu.algos import ph as ph_mod
-    from mpisppy_tpu.cylinders import hub as hub_mod
-    from mpisppy_tpu.cylinders import spoke as spoke_mod
     from mpisppy_tpu.ops import pdhg
-    from mpisppy_tpu.spin_the_wheel import WheelSpinner
-
-    batch, _ = _sslp_batch(SSLP_SCENS)
-    n_iters = 3 if SMOKE else 10
-    ph_opts = ph_mod.PHOptions(
+    return ph_mod.PHOptions(
         default_rho=20.0, max_iterations=n_iters, conv_thresh=0.0,
         subproblem_windows=8,
         pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40,
                               iter_precision=ITER_PRECISION))
 
-    # bare PH (compile excluded)
-    rho = jnp.full((batch.num_nonants,), ph_opts.default_rho)
-    state, _, _ = ph_mod.ph_iter0(batch, rho, ph_opts)
-    state = ph_mod.ph_iterk(batch, state, ph_opts)
-    jax.block_until_ready(state.conv)
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        state = ph_mod.ph_iterk(batch, state, ph_opts)
-    jax.block_until_ready(state.conv)
-    bare = (time.perf_counter() - t0) / n_iters
 
-    # full fused wheel: hub + Lagrangian + xhat-xbar + slam + shuffle
-    hub = {
-        "hub_class": hub_mod.PHHub,
-        "opt_class": fw.FusedPH,
-        "opt_kwargs": {"options": ph_opts, "batch": batch,
-                       "wheel_options": fw.FusedWheelOptions(
-                           slam_windows=2, shuffle_windows=4,
-                           spoke_period=3)},
-        "hub_kwargs": {"options": {"rel_gap": 0.0}},
-    }
-    spokes = [
+def _overhead_wheel_options():
+    from mpisppy_tpu.algos import fused_wheel as fw
+    return fw.FusedWheelOptions(slam_windows=2, shuffle_windows=4,
+                                spoke_period=3)
+
+
+def _overhead_spokes():
+    from mpisppy_tpu.cylinders import spoke as spoke_mod
+    return [
         {"spoke_class": spoke_mod.FusedLagrangianOuterBound,
          "opt_kwargs": {"options": {}}},
         {"spoke_class": spoke_mod.FusedXhatXbarInnerBound,
@@ -471,7 +447,54 @@ def bench_wheel_overhead():
         {"spoke_class": spoke_mod.FusedSlamHeuristic,
          "opt_kwargs": {"options": {}}},
     ]
-    wheel = WheelSpinner(hub, spokes)
+
+
+def _bare_ph_sec_per_iter(batch, ph_opts, n_iters):
+    """Bare-PH per-iteration wall clock (compile + iter0 excluded) —
+    the shared denominator of both overhead factors."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.algos import ph as ph_mod
+
+    rho = jnp.full((batch.num_nonants,), ph_opts.default_rho)
+    state, _, _ = ph_mod.ph_iter0(batch, rho, ph_opts)
+    state = ph_mod.ph_iterk(batch, state, ph_opts)
+    jax.block_until_ready(state.conv)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state = ph_mod.ph_iterk(batch, state, ph_opts)
+    jax.block_until_ready(state.conv)
+    return (time.perf_counter() - t0) / n_iters
+
+
+def bench_wheel_overhead():
+    """Wheel overhead: per-iteration wall-clock of a full hub + 4-bound
+    wheel vs bare PH on the same batch.  Round 3 measured 642x with
+    every spoke a separate to-convergence device dispatch; the fused
+    wheel (algos.fused_wheel — Lagrangian + xhat-xbar + slam + shuffle
+    planes INSIDE the hub's jitted step, fixed warm budgets) is the
+    round-4 answer.  Target: overhead factor <= 5x."""
+    import jax
+
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    batch, _ = _sslp_batch(SSLP_SCENS)
+    n_iters = 3 if SMOKE else 10
+    ph_opts = _overhead_ph_opts(n_iters)
+    bare = _bare_ph_sec_per_iter(batch, ph_opts, n_iters)
+
+    # full fused wheel: hub + Lagrangian + xhat-xbar + slam + shuffle
+    hub = {
+        "hub_class": hub_mod.PHHub,
+        "opt_class": fw.FusedPH,
+        "opt_kwargs": {"options": ph_opts, "batch": batch,
+                       "wheel_options": _overhead_wheel_options()},
+        "hub_kwargs": {"options": {"rel_gap": 0.0}},
+    }
+    wheel = WheelSpinner(hub, _overhead_spokes())
     wheel.spin()
     jax.block_until_ready(wheel.opt.state.conv)
     # steady-state per-iteration cost from the hub trace timestamps,
@@ -489,6 +512,65 @@ def bench_wheel_overhead():
                 "planes inside the hub step at spoke_period=3 (the same "
                 "exchange cadence round 3's classic wheel used)",
     }
+
+
+def bench_wheel_overhead_async():
+    """Async-wheel overhead (ISSUE 11; ROADMAP item 4): per-iteration
+    wall-clock of the async hub at staleness 0/1/2 vs bare PH on the
+    same batch.  staleness 0 is the synchronous degrade (must match the
+    wheel_overhead phase's structure); staleness >= 1 overlaps the host
+    exchange with device iterations on the double-buffered plane.  The
+    headline `overhead_factor` (staleness 1) carries the <= 1.3 ratchet
+    MILESTONE (telemetry/regress.py)."""
+    import jax
+
+    from mpisppy_tpu.algos import async_wheel as aw
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    batch, _ = _sslp_batch(SSLP_SCENS)
+    n_iters = 8 if SMOKE else 12
+    ph_opts = _overhead_ph_opts(n_iters)
+    bare = _bare_ph_sec_per_iter(batch, ph_opts, n_iters)
+
+    out = {"bare_ph_sec_per_iter": round(bare, 4),
+           "iter_precision": ITER_PRECISION or "bf16x6"}
+    for s in (0, 1, 2):
+        hub = {
+            "hub_class": hub_mod.AsyncPHHub,
+            "opt_class": aw.AsyncFusedPH,
+            "opt_kwargs": {
+                "options": ph_opts, "batch": batch,
+                "wheel_options": _overhead_wheel_options(),
+                "async_options": aw.AsyncWheelOptions(staleness=s)},
+            "hub_kwargs": {"options": {"rel_gap": 0.0,
+                                       "async_staleness": s}},
+        }
+        wheel = WheelSpinner(hub, _overhead_spokes())
+        wheel.spin()
+        jax.block_until_ready(wheel.opt.state.conv)
+        ts = [row["t"] for row in wheel.spcomm.trace]
+        # drop iter0 + the first TWO iterk rows: the stale-prox step
+        # and the plane programs compile across the first two syncs
+        drop = 3 if len(ts) > 5 else (2 if len(ts) > 3 else 1)
+        steady = np.diff(ts[drop:]) if len(ts) > drop + 1 \
+            else np.diff(ts)
+        per_iter = float(np.median(steady)) if len(steady) \
+            else float("nan")
+        out[f"s{s}"] = {
+            "staleness": s,
+            "wheel_sec_per_iter": round(per_iter, 4),
+            "overhead_factor": round(per_iter / bare, 3),
+        }
+    # the MILESTONE headline: staleness 1 at the spoke_period=3
+    # exchange cadence (the same cadence wheel_overhead measures)
+    out["overhead_factor"] = out["s1"]["overhead_factor"]
+    out["note"] = ("async wheel (double-buffered exchange plane, "
+                   "theta-damped stale-prox hub step) at staleness "
+                   "0/1/2 vs bare PH; median steady-state sec/iter "
+                   "(compile + iter0 excluded); headline "
+                   "overhead_factor is staleness 1")
+    return out
 
 
 def bench_uc_fwph():
@@ -799,6 +881,7 @@ _PHASES = {
     "uc_fwph_hub_to_1pct_gap": lambda: bench_uc_fwph_hub(),
     "hydro_to_1pct_gap": lambda: bench_hydro(),
     "wheel_overhead": lambda: bench_wheel_overhead(),
+    "wheel_overhead_async": lambda: bench_wheel_overhead_async(),
     "measured_mfu": lambda: bench_measured_mfu(),
     "baseline_anchor": lambda: bench_baseline_anchor(),
 }

@@ -145,6 +145,44 @@ def aph_iter0(batch: ScenarioBatch, rho: Array, opts: APHOptions):
     return st, trivial_bound, certified
 
 
+def projective_theta(batch: ScenarioBatch, x_non: Array, xbar: Array,
+                     W: Array, z_plane: Array, W_plane: Array,
+                     rho: Array, nu: float = 1.0,
+                     gamma: float = 1.0) -> Array:
+    """APH Steps 16-17 (tau/phi/theta) against an arbitrary prox center
+    — the damping the async wheel applies to its stale-plane hub step
+    (algos/fused_wheel.ph_stale_step; docs/async_wheel.md).
+
+    With z = the stale exchange plane's x̄ and y formed at the PLANE's
+    era (y = W_plane + rho (x - z), Eq. 25 with the duals the plane
+    carried — mirroring how aph_iterk's stored y predates the W it is
+    tested against), phi = E<z - x, W - y> is the genuine separating-
+    hyperplane progress of the stale direction measured against the
+    CURRENT duals.  Forming y from W itself would degenerate phi to
+    the always-nonnegative rho * E||x - z||^2 and disable the Step-16
+    rejection entirely.  theta = nu * phi / tau contracts toward 0
+    when that progress is small relative to the step norm tau — the
+    regime where applying a stale update at full strength would
+    overshoot — and the rejection branch (phi <= 0 -> theta = 0) fires
+    for a genuinely adverse plane (torn or ancient duals pointing
+    against the current iterate).  Clipped to [0, 1]: theta = 1
+    recovers the undamped PH multiplier update, and the caller may
+    floor it to keep duals moving near convergence."""
+    u = x_non - xbar                               # Eq. 27
+    y = W_plane + rho * (x_non - z_plane)          # Eq. 25, plane era
+    ybar, _ = batch.node_average(y)
+    pusq = batch.expectation(jnp.sum(u * u, axis=-1))
+    pvsq = batch.expectation(jnp.sum(ybar * ybar, axis=-1))
+    tau = pusq + pvsq / gamma
+    phi = batch.expectation(
+        jnp.sum((z_plane - x_non) * (W - y), axis=-1))
+    dt = x_non.dtype
+    theta = jnp.where((tau > 0) & (phi > 0),
+                      nu * phi / jnp.maximum(tau, 1e-30),
+                      jnp.zeros((), dt))
+    return jnp.clip(theta, 0.0, 1.0).astype(dt)
+
+
 def _dispatch_mask(batch: ScenarioBatch, st: APHState, n_dispatch: int):
     """Select the n_dispatch stalest real scenarios (the dispatch record,
     ref:opt/aph.py:164-168,756+: least-recently-solved first)."""

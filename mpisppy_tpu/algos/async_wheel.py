@@ -1,0 +1,261 @@
+###############################################################################
+# Asynchronous wheel driver (ISSUE 11 tentpole; ROADMAP item 4;
+# docs/async_wheel.md).
+#
+# The synchronous fused wheel serializes harvest -> validate ->
+# plane-write -> device step every sync: the device idles while the
+# host completes the exchange and vice versa (2.41x over bare PH,
+# BENCH_DETAIL.json wheel_overhead).  APH (Eckstein et al., transcribed
+# in algos/aph.py) names the cure — run projections and bounds without
+# a barrier against a stale-but-bounded plane — and the
+# Proximal-Proximal-Gradient line (PAPERS.md, arXiv:1708.06908)
+# supplies the convergence frame for prox iterations against a stale
+# W/x̄ center.
+#
+# Mechanics (staleness s >= 1):
+#
+#   * a DOUBLE-BUFFERED exchange plane (two ExchangePlane slots of
+#     device refs): the device step of iteration k reads slot k mod 2,
+#     the host writes slot (k+1) mod 2 with generation k+1-s (a delay
+#     line of device refs — a plane write is a pointer swap, never a
+#     transfer);
+#   * the hub PH step proxes around the PLANE x̄ with the multiplier
+#     update theta-damped by the APH projective step length
+#     (fused_wheel.ph_stale_step) so stale updates stay convergent;
+#   * the spoke planes (Lagrangian / x̂ / slam / shuffle) evaluate AT
+#     the plane — L(W) is a certified outer bound at ANY W, and every
+#     candidate evaluation keeps its feasibility + comp-tightness
+#     gates, so staleness can delay bounds but never invalidate them;
+#   * plane dispatches ride fire-and-forget PlaneTickets through the
+#     dispatch scheduler (PR-8 deadline semantics: a wedged exchange
+#     becomes a typed SolveFailed / a watchdog trip, never a hang);
+#   * the host reads results pipelined (the existing depth-2 scalar
+#     cache plus a one-slot theta pipeline), so it never blocks on the
+#     in-flight step — host exchange work overlaps device iterations.
+#
+# staleness = 0 degrades to the synchronous FusedPH path UNTOUCHED
+# (same jitted programs, same host loop), so trajectories are
+# bit-identical by construction — tests/test_async_wheel.py asserts it
+# on bounds, trace events and checkpoint contents.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from mpisppy_tpu.algos import fused_wheel as fw
+from mpisppy_tpu.algos import ph as ph_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncWheelOptions:
+    """Host-side async-wheel knobs (CLI: --async-staleness).
+
+    staleness: hard bound on how many iterations the exchange plane
+    may lag the device step (0 = synchronous; fault injection may
+    exceed it deliberately — validity never depends on it).  nu/gamma
+    feed the APH theta formula; theta_floor keeps the damped multiplier
+    update flowing near convergence (docs/async_wheel.md).
+    exchange_deadline_s bounds how long the exchange may block on any
+    plane ticket before a typed SolveFailed surfaces."""
+
+    staleness: int = 1
+    nu: float = 1.0
+    gamma: float = 1.0
+    theta_floor: float = 0.05
+    exchange_deadline_s: float | None = None
+
+
+class AsyncFusedPH(fw.FusedPH):
+    """FusedPH whose iteration runs against the double-buffered stale
+    exchange plane.  Pair with cylinders.hub.AsyncPHHub (which emits
+    the plane-write/overlap telemetry and runs the host-complete half
+    of the exchange on the stale side of the pipeline)."""
+
+    def __init__(self, options, batch, wheel_options=None,
+                 async_options: AsyncWheelOptions | None = None, **kw):
+        super().__init__(options, batch, wheel_options, **kw)
+        self.async_options = async_options or AsyncWheelOptions()
+        # double buffer of ExchangePlane device-ref slots; a "write" is
+        # a host-side pointer swap (arrays are immutable), routed
+        # through the fault plan's torn/dropped-write seams.  Touched
+        # only on the hub driver thread — the background checkpoint
+        # writer never reads the ring.
+        self._plane_slots: list = [None, None]
+        self._plane_slot_gen: list = [0, 0]  # generation each slot holds
+        self._plane_delay: list = []   # generation delay line, len <= s
+        self._theta_inflight = None    # () device scalar, 1-deep pipeline
+        self.last_theta: float | None = None
+        self.plane_events: list[dict] = []   # drained by AsyncPHHub
+        self._exchange_tickets: list = []    # THIS iteration's tickets
+        self._tickets_due: list = []         # previous iteration's
+
+    # -- plane bookkeeping ------------------------------------------------
+    def take_plane_events(self) -> list[dict]:
+        out, self.plane_events = self.plane_events, []
+        return out
+
+    def _write_plane(self, phst: ph_mod.PHState):
+        """Append generation self._iter to the delay line and write the
+        due generation into slot (iter+1) mod 2 — the slot the NEXT
+        iteration's device step reads.  The fault plan's async-exchange
+        seams (drop / torn swap) intercept here; the recorded event
+        carries the generation the slot ACTUALLY holds afterwards, so
+        a dropped/torn write shows its observed staleness exceeding
+        the bound (exactly what the fault exists to probe)."""
+        s = max(1, int(self.async_options.staleness))
+        self._plane_delay.append((self._iter, fw.plane_of(phst)))
+        while len(self._plane_delay) > s:
+            self._plane_delay.pop(0)
+        gen, plane = self._plane_delay[0]
+        slot = (self._iter + 1) % 2
+        plan = self.options_fault_plan()
+        old = self._plane_slots[slot]
+        if plan is not None and old is not None:
+            filtered = plan.filter_plane_write(self._iter, plane, old)
+            if filtered is old:
+                # dropped write: the slot keeps its previous generation
+                gen = self._plane_slot_gen[slot]
+            elif filtered is not plane:
+                # torn swap: the stalest mixed-in component governs
+                # what the device actually reads
+                gen = min(self._plane_slot_gen[slot], gen)
+            plane = filtered
+        self._plane_slots[slot] = plane
+        self._plane_slot_gen[slot] = gen
+        self.plane_events.append({
+            "slot": slot, "generation": gen,
+            "staleness": self._iter + 1 - gen})
+
+    def options_fault_plan(self):
+        """The run's FaultPlan, if the hub armed one (the hub owns the
+        options dict; the driver only reads the seam)."""
+        spcomm = getattr(self, "spcomm", None)
+        if spcomm is None:
+            return None
+        return spcomm.options.get("fault_plan")
+
+    # -- iteration --------------------------------------------------------
+    def _iter0_impl(self):
+        phst, tb, cert = super()._iter0_impl()
+        if int(self.async_options.staleness) > 0:
+            # seed both slots with the iter0 generation so the first
+            # iterk reads a valid plane (staleness 1 at iteration 1)
+            plane = fw.plane_of(self.wstate.ph)
+            self._plane_slots = [plane, plane]
+            self._plane_slot_gen = [0, 0]
+            self._plane_delay = [(0, plane)]
+        return phst, tb, cert
+
+    def _iterk_impl(self):
+        if int(self.async_options.staleness) <= 0:
+            # synchronous degrade: the untouched FusedPH path —
+            # bit-identical trajectories (tested)
+            return super()._iterk_impl()
+        return self._iterk_async()
+
+    def _plane_dispatch(self, label, fn, *args):
+        """One fire-and-forget plane dispatch: through the scheduler's
+        PlaneTicket when one is configured (PR-8 deadline semantics),
+        else a direct async XLA dispatch."""
+        from mpisppy_tpu import dispatch as _dispatch
+        sched = _dispatch.get_scheduler(create=False)
+        if sched is None:
+            return fn(*args)
+        ticket = sched.submit_plane(
+            fn, *args, label=label,
+            deadline_s=self.async_options.exchange_deadline_s)
+        self._exchange_tickets.append(ticket)
+        return ticket.value
+
+    def result_exchange(self):
+        """Bounded settle of the PREVIOUS iteration's plane tickets —
+        the host-complete half's 'observe a result or a typed
+        SolveFailed' point (dispatch/scheduler.PlaneTicket).  The
+        current iteration's tickets stay in flight (settling them here
+        would re-introduce the host<->device barrier this wheel
+        removes); they rotate into the due list at the next iterk and
+        settle one sync later, after a full iteration to land."""
+        tickets, self._tickets_due = self._tickets_due, []
+        self._settle(tickets)
+
+    def _settle(self, tickets):
+        """Settle EVERY ticket — one wedged dispatch must not leave its
+        siblings unsettled/uncounted (each gets its result-or-typed-
+        SolveFailed observation); the first failure re-raises after the
+        sweep."""
+        deadline = self.async_options.exchange_deadline_s
+        first_exc = None
+        for t in tickets:
+            try:
+                t.result(timeout=deadline)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+
+    def _iterk_async(self):
+        aopts = self.async_options
+        batch = self.batch
+        # rotate: LAST iteration's tickets become settleable at this
+        # sync's host-complete half (result_exchange)
+        self._tickets_due.extend(self._exchange_tickets)
+        self._exchange_tickets = []
+        # self-defense for a mispaired hub (public API: this driver
+        # under a plain PHHub never gets result_exchange /
+        # take_plane_events calls): a properly paired hub drains both
+        # every sync, so growth past a few iterations' worth means
+        # nobody is draining — settle/trim here rather than pin every
+        # ticket's device arrays for the whole run
+        if len(self._tickets_due) > 32:
+            due, self._tickets_due = self._tickets_due, []
+            self._settle(due)
+        if len(self.plane_events) > 32:
+            del self.plane_events[:-8]
+        sid, spoke_iter = self._draw_spoke_cycle()
+        plane = self._plane_slots[self._iter % 2]
+        if plane is None:
+            # restored from a checkpoint: load_checkpoint skips
+            # _iter0_impl, so re-seed both slots (and the delay line's
+            # generation stamp) from the restored state — the first
+            # resumed write then reports staleness 1, like iteration 1
+            plane = fw.plane_of(self.wstate.ph)
+            self._plane_slots = [plane, plane]
+            self._plane_slot_gen = [self._iter - 1, self._iter - 1]
+            self._plane_delay = [(self._iter - 1, plane)]
+        # device-issue half: the theta-damped hub step against the
+        # stale plane, then every enabled spoke plane AT the plane —
+        # none of their inputs depend on this step's output, so the
+        # dispatches are data-independent of it
+        phst, theta = fw.ph_stale_step(
+            batch, self.state, plane, ph_mod.kernel_opts(self.options),
+            aopts.nu, aopts.gamma, aopts.theta_floor)
+        out = dataclasses.replace(self.wstate, ph=phst)
+        if spoke_iter:
+            out = self._dispatch_spoke_planes(
+                out, plane.W, plane.xbar_nodes, plane.x, sid,
+                dispatch=self._plane_dispatch)
+        self.wstate = dataclasses.replace(
+            out, scalars=fw._pack_scalars_jit(out))
+        self._write_plane(phst)
+        # pipelined host reads: the PREVIOUS iteration's packed scalars
+        # and theta — the host never blocks on the in-flight step
+        prev_theta, self._theta_inflight = self._theta_inflight, theta
+        if prev_theta is not None:
+            self.last_theta = float(np.asarray(prev_theta))
+        self._cache_scalars(pipelined=True)
+        if spoke_iter:
+            self._observe_progress()
+        return self.wstate.ph
+
+    def flush_scalars(self):
+        super().flush_scalars()
+        # finalize path: settle every outstanding plane ticket so the
+        # last iteration's dispatches keep the typed-failure contract
+        due, self._tickets_due = self._tickets_due, []
+        cur, self._exchange_tickets = self._exchange_tickets, []
+        self._settle(due + cur)
+        if self._theta_inflight is not None:
+            self.last_theta = float(np.asarray(self._theta_inflight))

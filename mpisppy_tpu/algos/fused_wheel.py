@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpisppy_tpu.algos import aph as aph_mod
 from mpisppy_tpu.algos import lagrangian as lag_mod
 from mpisppy_tpu.algos import ph as ph_mod
 from mpisppy_tpu.algos import xhat as xhat_mod
@@ -487,6 +488,79 @@ def fused_iterk(batch: ScenarioBatch, st: FusedWheelState,
     return dataclasses.replace(out, scalars=_pack_scalars(out))
 
 
+# --- async exchange plane (ISSUE 11 tentpole; docs/async_wheel.md) ----
+# One slot of the double-buffered host<->device exchange plane: the
+# W/x̄/iterate view the spoke planes and the stale-prox hub step read at
+# iteration k while the host completes the exchange for an earlier
+# iteration.  Slots hold DEVICE REFS (arrays are immutable; a "plane
+# write" is a host-side pointer swap, never a transfer), so the ring in
+# algos/async_wheel.AsyncFusedPH costs no HBM beyond the generations it
+# pins alive.
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["W", "xbar", "xbar_nodes", "x"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class ExchangePlane:
+    W: Array           # (S, N) duals at the plane's generation
+    xbar: Array        # (S, N) per-scenario view of node averages
+    xbar_nodes: Array  # (num_nodes, N)
+    x: Array           # (S, n) full primal iterates (slam/shuf inputs)
+
+
+def plane_of(phst: ph_mod.PHState) -> ExchangePlane:
+    """The exchange-plane view of one PH state generation."""
+    return ExchangePlane(W=phst.W, xbar=phst.xbar,
+                         xbar_nodes=phst.xbar_nodes, x=phst.solver.x)
+
+
+@partial(jax.jit, static_argnames=("opts", "nu", "gamma", "theta_floor"))
+def ph_stale_step(batch: ScenarioBatch, st: ph_mod.PHState,
+                  plane: ExchangePlane, opts: ph_mod.PHOptions,
+                  nu: float = 1.0, gamma: float = 1.0,
+                  theta_floor: float = 0.05):
+    """One theta-damped PH hub step against a (possibly stale) exchange
+    plane — the APH-class stale-plane hub iteration (ISSUE 11;
+    docs/async_wheel.md).
+
+    The subproblem proxes around the PLANE's x̄ (the center the device
+    can form without waiting for the host exchange) instead of the
+    state's own freshest average; the multiplier update is then damped
+    by the APH projective step length (algos/aph.projective_theta):
+
+        W_new = W + theta * rho * (x_new - x̄_new),  theta in [floor, 1]
+
+    At plane == the previous iteration's output and theta == 1 this is
+    EXACTLY ph_iterk (synchronous PH already proxes around the previous
+    x̄), so staleness-1 deviates from the synchronous trajectory only by
+    the damping; deeper staleness lags the prox center further, and
+    theta contracts automatically when the stale direction stops making
+    projective progress.  Returns (new_state, theta)."""
+    smooth_p = opts.smooth_p if opts.smoothed else 0.0
+    qp_eff = ph_mod._prox_qp(batch, st.W, plane.xbar, st.z, st.rho,
+                             smooth_p)
+    solver = pdhg.solve_fixed(qp_eff, opts.subproblem_windows, opts.pdhg,
+                              st.solver)
+    st2 = dataclasses.replace(st, solver=solver)
+    x_non, xbar, xbar_nodes, xsqbar, W_full, z, conv = ph_mod._xbar_w_conv(
+        batch, st2, opts.smooth_beta, opts.smoothed, opts.compute_xsqbar)
+    theta = aph_mod.projective_theta(batch, x_non, xbar, st.W, plane.xbar,
+                                     plane.W, st.rho, nu, gamma)
+    # floor: near convergence phi ~ ||x - z_plane||^2 -> 0 would freeze
+    # the duals entirely; a small floor keeps the (already tiny) PH
+    # update flowing (docs/async_wheel.md theta-damping rationale)
+    theta = jnp.maximum(theta, jnp.asarray(theta_floor, theta.dtype))
+    # W_full is st.W + rho*(x - xbar) (masked for var_prob batches by
+    # _xbar_w_conv), so blending recovers the damped update exactly
+    W = st.W + theta * (W_full - st.W)
+    out = dataclasses.replace(st2, W=W, z=z, xbar=xbar,
+                              xbar_nodes=xbar_nodes, xsqbar=xsqbar,
+                              conv=conv)
+    return out, theta
+
+
 # --- split-dispatch plane programs -----------------------------------
 # Each plane as its own small jitted program (see
 # FusedWheelOptions.split_dispatch).  `windows` is static: the adaptive
@@ -641,13 +715,21 @@ class FusedPH(ph_mod.PH):
         self._cache_scalars()
         return self.wstate.ph, tb, cert
 
-    def _iterk_impl(self):
+    def _draw_spoke_cycle(self):
+        """Advance the shuffle cursor one draw and evaluate the spoke
+        cadence for this iteration — the ONE place the (sid, spoke_iter)
+        pair comes from, shared with the async driver's stale path so
+        shuffle/cadence semantics can never drift between the two
+        iteration paths."""
         sid = jnp.asarray(
             int(self._shuf_order[self._shuf_cursor]), jnp.int32)
         self._shuf_cursor = (self._shuf_cursor + 1) % len(self._shuf_order)
+        p = max(1, int(self.wheel_options.spoke_period))
+        return sid, p <= 1 or (self._iter % p) == 0
+
+    def _iterk_impl(self):
+        sid, spoke_iter = self._draw_spoke_cycle()
         wopts = self.wheel_options
-        p = max(1, int(wopts.spoke_period))
-        spoke_iter = p <= 1 or (self._iter % p) == 0
         split = wopts.split_dispatch
         if split is None:
             split = self.batch.num_real >= 512
@@ -673,6 +755,45 @@ class FusedPH(ph_mod.PH):
             self._observe_progress()
         return self.wstate.ph
 
+    def _next_xhat_cand(self, xbar_nodes, current_cand):
+        """The x̂ plane's freeze/rotate candidate policy, shared by the
+        split-dispatch pipeline and the async wheel (which derives
+        xbar_nodes from its stale exchange plane).
+
+        The pipelined scalar cache lags SCALAR_PIPELINE_DEPTH
+        iterations (see _cache_scalars), so right after an adoption the
+        landed/dead flags still describe the PREVIOUS candidate —
+        acting on them would rotate twice and skip a rounding tier;
+        trust them only once this candidate has been evaluated
+        pipeline-depth exchanges."""
+        sc = self.scalar_cache or {}
+        wopts = self.wheel_options
+        flags_fresh = self._xhat_frozen_for >= SCALAR_PIPELINE_DEPTH
+        landed = flags_fresh and bool(sc.get("xhat_feasible", 0.0))
+        dead = flags_fresh and bool(sc.get("xhat_dead", 0.0))
+        give_up = self._xhat_frozen_for >= wopts.xhat_give_up
+        if landed or dead or give_up or not self._xhat_has_cand:
+            if landed:
+                # a landed candidate validates the current rounding
+                # direction — keep it
+                pass
+            elif dead or give_up:
+                # escalate the rounding direction: on sslp-like models
+                # nearest-rounding strands recourse demand and the
+                # candidate is CERTIFIED dead; ceil opens every
+                # fractional facility
+                order = ("nearest", "ceil", "floor")
+                i = order.index(self._xhat_round_mode)
+                self._xhat_round_mode = order[(i + 1) % 3]
+            cand = _round_xbar(self.batch, xbar_nodes,
+                               self._xhat_round_mode)
+            self._xhat_frozen_for = 0
+            self._xhat_has_cand = True
+        else:
+            cand = current_cand  # frozen: keep accumulating
+            self._xhat_frozen_for += 1
+        return cand
+
     def _iterk_split(self, wopts: FusedWheelOptions, sid,
                      spoke_iter: bool) -> FusedWheelState:
         """One wheel iteration as a PIPELINE of async dispatches: the
@@ -686,66 +807,54 @@ class FusedPH(ph_mod.PH):
                                ph_mod.kernel_opts(self.options))
         out = dataclasses.replace(self.wstate, ph=phst)
         if spoke_iter:
-            b = self._budgets
-            if b["lag"].windows() > 0:
-                ls, lb, lc = lag_plane(batch, phst.W, out.lag_solver,
-                                       wopts, b["lag"].windows())
-                out = dataclasses.replace(
-                    out, lag_solver=ls, lag_bound=lb, lag_certified=lc)
-            if b["xhat"].windows() > 0:
-                sc = self.scalar_cache or {}
-                # the pipelined scalar cache lags SCALAR_PIPELINE_DEPTH
-                # iterations (see _cache_scalars), so right after an
-                # adoption the landed/dead flags still describe the
-                # PREVIOUS candidate — acting on them would rotate
-                # twice and skip a rounding tier; trust them only once
-                # this candidate has been evaluated pipeline-depth
-                # exchanges
-                flags_fresh = (self._xhat_frozen_for
-                               >= SCALAR_PIPELINE_DEPTH)
-                landed = flags_fresh and bool(sc.get("xhat_feasible", 0.0))
-                dead = flags_fresh and bool(sc.get("xhat_dead", 0.0))
-                give_up = self._xhat_frozen_for >= wopts.xhat_give_up
-                if landed or dead or give_up or not self._xhat_has_cand:
-                    if landed:
-                        # a landed candidate validates the current
-                        # rounding direction — keep it
-                        pass
-                    elif dead or give_up:
-                        # escalate the rounding direction: on sslp-like
-                        # models nearest-rounding strands recourse
-                        # demand and the candidate is CERTIFIED dead;
-                        # ceil opens every fractional facility
-                        order = ("nearest", "ceil", "floor")
-                        i = order.index(self._xhat_round_mode)
-                        self._xhat_round_mode = order[(i + 1) % 3]
-                    cand = _round_xbar(batch, phst.xbar_nodes,
-                                       self._xhat_round_mode)
-                    self._xhat_frozen_for = 0
-                    self._xhat_has_cand = True
-                else:
-                    cand = out.xhat_cand  # frozen: keep accumulating
-                    self._xhat_frozen_for += 1
-                xs, xv, xf, xd = xhat_plane(batch, cand, out.xhat_solver,
-                                            wopts, b["xhat"].windows())
-                out = dataclasses.replace(
-                    out, xhat_solver=xs, xhat_cand=cand, xhat_value=xv,
-                    xhat_feasible=xf, xhat_dead=xd)
-            if b["slam"].windows() > 0:
-                ss, scand, sv, sf = slam_plane(
-                    batch, phst.solver.x, out.slam_solver, wopts,
-                    b["slam"].windows(), wopts.slam_sense_max)
-                out = dataclasses.replace(
-                    out, slam_solver=ss, slam_cand=scand, slam_value=sv,
-                    slam_feasible=sf)
-            if b["shuf"].windows() > 0:
-                fs, fcand, fv, ff = shuf_plane(
-                    batch, phst.solver.x, out.shuf_solver, sid, wopts,
-                    b["shuf"].windows())
-                out = dataclasses.replace(
-                    out, shuf_solver=fs, shuf_cand=fcand, shuf_value=fv,
-                    shuf_feasible=ff)
+            out = self._dispatch_spoke_planes(out, phst.W,
+                                              phst.xbar_nodes,
+                                              phst.solver.x, sid)
         return dataclasses.replace(out, scalars=_pack_scalars_jit(out))
+
+    def _dispatch_spoke_planes(self, out, W, xbar_nodes, x, sid,
+                               dispatch=None):
+        """The four spoke-plane dispatches against one (W, x̄-nodes, x)
+        view — the current step's outputs on the synchronous split
+        path, the stale exchange plane on the async wheel.  `dispatch`
+        wraps each plane call (the async wheel routes through
+        fire-and-forget PlaneTickets); the default is the direct async
+        XLA dispatch."""
+        if dispatch is None:
+            def dispatch(label, fn, *args):
+                return fn(*args)
+        wopts = self.wheel_options
+        batch = self.batch
+        b = self._budgets
+        if b["lag"].windows() > 0:
+            ls, lb, lc = dispatch("lag", lag_plane, batch, W,
+                                  out.lag_solver, wopts,
+                                  b["lag"].windows())
+            out = dataclasses.replace(
+                out, lag_solver=ls, lag_bound=lb, lag_certified=lc)
+        if b["xhat"].windows() > 0:
+            cand = self._next_xhat_cand(xbar_nodes, out.xhat_cand)
+            xs, xv, xf, xd = dispatch("xhat", xhat_plane, batch, cand,
+                                      out.xhat_solver, wopts,
+                                      b["xhat"].windows())
+            out = dataclasses.replace(
+                out, xhat_solver=xs, xhat_cand=cand, xhat_value=xv,
+                xhat_feasible=xf, xhat_dead=xd)
+        if b["slam"].windows() > 0:
+            ss, scand, sv, sf = dispatch(
+                "slam", slam_plane, batch, x, out.slam_solver, wopts,
+                b["slam"].windows(), wopts.slam_sense_max)
+            out = dataclasses.replace(
+                out, slam_solver=ss, slam_cand=scand, slam_value=sv,
+                slam_feasible=sf)
+        if b["shuf"].windows() > 0:
+            fs, fcand, fv, ff = dispatch(
+                "shuf", shuf_plane, batch, x, out.shuf_solver, sid,
+                wopts, b["shuf"].windows())
+            out = dataclasses.replace(
+                out, shuf_solver=fs, shuf_cand=fcand, shuf_value=fv,
+                shuf_feasible=ff)
+        return out
 
     def _observe_progress(self):
         """Feed the (possibly one-iteration-stale, see _cache_scalars)

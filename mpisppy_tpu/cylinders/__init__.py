@@ -1,6 +1,6 @@
 from mpisppy_tpu.cylinders.spcommunicator import SPCommunicator  # noqa: F401
 from mpisppy_tpu.cylinders.hub import (  # noqa: F401
-    APHHub, Hub, LShapedHub, PHHub,
+    APHHub, AsyncPHHub, Hub, LShapedHub, PHHub,
 )
 from mpisppy_tpu.cylinders.spoke import (  # noqa: F401
     ConvergerSpokeType, Spoke, OuterBoundSpoke, InnerBoundSpoke,

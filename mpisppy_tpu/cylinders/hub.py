@@ -492,6 +492,11 @@ class PHHub(Hub):
             self._sync_body()
 
     def _sync_body(self):
+        self._sync_prologue()
+        self._sync_exchange()
+        self._sync_epilogue()
+
+    def _sync_prologue(self):
         # stamp the current hub iteration onto the out-of-band emitters
         # (dispatch megabatches, fault seams) so their events join the
         # iteration timeline exactly, not by seq-window heuristics
@@ -507,6 +512,11 @@ class PHHub(Hub):
             # pdhg lane guard has something real to catch
             plan.maybe_preempt(self._iter)
             plan.corrupt_lanes(self._iter, self.opt)
+
+    def _sync_exchange(self):
+        """The host exchange: harvest -> validate -> publish ->
+        checkpoint.  The async hub runs this as its host-complete half
+        while the next device step is already in flight."""
         period = max(1, int(self.options.get("spoke_sync_period", 1)))
         do_spokes = (self._iter <= 2) or (self._iter % period == 0)
         # fused spokes (algos.fused_wheel) compute inside the hub's own
@@ -540,6 +550,11 @@ class PHHub(Hub):
                             sp.update(payload)
         with self._span("checkpoint"):
             self._maybe_checkpoint()
+
+    def _sync_epilogue(self):
+        """Off-critical-path bookkeeping: the pipelined kernel-counter
+        harvest, dispatch stats, watchdog beat, and the per-iteration
+        trace row."""
         self._harvest_kernel_counters()
         self._harvest_dispatch_stats()
         abs_gap, rel_gap = self.compute_gaps()
@@ -594,29 +609,60 @@ class PHHub(Hub):
         return [(cyl, s) for cyl, s in out
                 if getattr(s, "counters", None) is not None]
 
-    def _harvest_kernel_counters(self):
+    def _harvest_kernel_counters(self, flush: bool = False):
         """Mirror cumulative on-device counters into the metrics
         registry and the event stream — one small transfer per solver
         per sync (the ring stays in HBM), and a strict no-op unless the
         kernels were built with telemetry=True (counters None
-        otherwise)."""
+        otherwise).
+
+        PIPELINED off the hub critical path (ISSUE 11 satellite): each
+        sync COMPLETES the harvest begun the previous sync (its async
+        host copies have long landed — no block on the in-flight step)
+        and BEGINS a fresh one on the current state.  Totals therefore
+        lag one sync in the stream; they are cumulative mirrors
+        (set_counter, monotone), and finalize calls with flush=True —
+        DISCARDING the pending one-sync-stale snapshot and taking one
+        synchronous harvest of the final state instead (folding both
+        would stamp duplicate kernel-counters rows on the final sync)
+        — so exported totals can never undercount the run
+        (regression-tested in tests/test_async_wheel.py)."""
+        from mpisppy_tpu.telemetry import counters as kcounters
         solvers = self._counter_solvers()
-        if not solvers:
+        pending = getattr(self, "_counters_pending", None)
+        if pending and not flush:
+            for cyl, handle in pending:
+                self._fold_counter_harvest(
+                    cyl, kcounters.complete_harvest(handle))
+        # on flush the pending one-sync-stale snapshot is discarded:
+        # the fresh synchronous harvest below supersedes it (totals are
+        # cumulative set_counter mirrors), and folding both would stamp
+        # two kernel-counters rows with different totals on the same
+        # final sync
+        self._counters_pending = [
+            (cyl, kcounters.begin_harvest(s, include_ring=False))
+            for cyl, s in solvers]
+        if flush:
+            for cyl, handle in self._counters_pending:
+                self._fold_counter_harvest(
+                    cyl, kcounters.complete_harvest(handle))
+            self._counters_pending = []
+
+    def _fold_counter_harvest(self, cyl: str, h: dict | None):
+        if h is None:
             return
         from mpisppy_tpu.telemetry import counters as kcounters
         from mpisppy_tpu.telemetry import metrics as metrics_mod
-        for cyl, solver in solvers:
-            h = kcounters.harvest_state(solver, include_ring=False)
-            kcounters.fold_into_registry(metrics_mod.REGISTRY, h, cyl=cyl)
-            if cyl != "hub":
-                continue
-            guard_total = h["pdhg_guard_resets_total"]
-            if guard_total > self._last_guard_total:
-                self._emit(tel.LANE_QUARANTINE,
-                           resets=guard_total - self._last_guard_total,
-                           total=guard_total)
-            self._last_guard_total = guard_total
-            self._emit(tel.KERNEL_COUNTERS, **h)
+        kcounters.fold_into_registry(metrics_mod.REGISTRY, h, cyl=cyl)
+        if cyl != "hub":
+            return
+        guard_total = h["pdhg_guard_resets_total"]
+        if guard_total > self._last_guard_total:
+            self._emit(tel.LANE_QUARANTINE,
+                       resets=guard_total - self._last_guard_total,
+                       total=guard_total)
+        self._last_guard_total = guard_total
+        self._emit(tel.KERNEL_COUNTERS, **h)
 
     # -- dispatch-scheduler stats harvest (docs/dispatch.md) --------------
     def _harvest_dispatch_stats(self):
@@ -950,7 +996,10 @@ class PHHub(Hub):
             t.join()
         if self._profiler is not None:
             self._profiler.close()
-        self._harvest_kernel_counters()  # final totals after last iterk
+        # final totals after the last iterk: complete the pipelined
+        # pending harvest AND take one synchronous final one, so the
+        # exported totals exactly match the device state
+        self._harvest_kernel_counters(flush=True)
         self.emit_run_end(getattr(self, "_term_reason", None)
                           or "max-iter")
         return self.BestInnerBound
@@ -1000,6 +1049,89 @@ class PHHub(Hub):
 
     def _fallback_nonants(self) -> np.ndarray:
         return np.asarray(self.opt.state.xbar_nodes)
+
+
+class AsyncPHHub(PHHub):
+    """Asynchronous exchange hub (ISSUE 11 tentpole;
+    docs/async_wheel.md).  Pair with algos.async_wheel.AsyncFusedPH.
+
+    options['async_staleness'] = s >= 1 splits every sync into a
+    device-issue half (iteration stamping, fault seams, the driver's
+    plane write — all while the just-dispatched step runs) and a
+    host-complete half (harvest -> validate -> publish -> checkpoint,
+    all against information the depth-2 scalar pipeline already
+    landed), so the host exchange overlaps device iterations instead
+    of serializing between dispatches.  The kernel-counter harvest is
+    pipelined in the base hub already (begin now / complete next sync);
+    here the plane-write and overlap attribution additionally land in
+    the trace (`plane-write`, `exchange-overlap` events).
+
+    s = 0 routes every sync through the synchronous PHHub body —
+    trajectories, trace events and checkpoints are bit-identical to a
+    plain PHHub wheel by construction (tested)."""
+
+    def _async_staleness(self) -> int:
+        """The ONE staleness source of truth is the driver's
+        AsyncWheelOptions (it owns the delay line and decides between
+        the sync and stale iteration paths); options['async_staleness']
+        is only the CLI mirror.  Deriving the hub's routing from the
+        driver — and refusing a contradictory mirror — means an
+        AsyncFusedPH paired with this hub can never silently run the
+        synchronous body while the driver queues plane tickets and
+        events nobody drains."""
+        aopts = getattr(self.opt, "async_options", None)
+        drv = None if aopts is None else int(aopts.staleness)
+        mirror = self.options.get("async_staleness")
+        if drv is not None and mirror is not None and int(mirror) != drv:
+            raise ValueError(
+                f"async_staleness mismatch: hub options carry "
+                f"{int(mirror)} but the driver's AsyncWheelOptions "
+                f"carry {drv} — set one (the driver's is "
+                f"authoritative)")
+        if drv is not None:
+            return drv
+        return int(mirror or 0)
+
+    def _sync_body(self):
+        staleness = self._async_staleness()
+        if staleness <= 0:
+            return super()._sync_body()
+        from mpisppy_tpu.telemetry import metrics as metrics_mod
+        t0 = time.perf_counter()
+        with self._span("exchange_issue"):
+            self._sync_prologue()
+            plan = self.options.get("fault_plan")
+            # the driver recorded its plane writes while dispatching
+            # this iteration; stamp them onto the stream here (the
+            # driver has no bus)
+            for evd in getattr(self.opt, "take_plane_events",
+                               lambda: [])():
+                self._emit(tel.PLANE_WRITE, **evd)
+                metrics_mod.REGISTRY.inc("async_plane_writes_total")
+                metrics_mod.REGISTRY.set_gauge(
+                    "async_plane_staleness",
+                    float(evd.get("staleness", 0)))
+        t1 = time.perf_counter()
+        with self._span("exchange_complete"):
+            if plan is not None:
+                # chaos seam: a slow host harvest (resilience/faults
+                # AsyncExchangeFault) — the wedged-exchange case the
+                # hub watchdog must still catch
+                plan.before_harvest(self._iter)
+            # settle the PREVIOUS iteration's plane tickets with the
+            # PR-8 bounded-wait semantics (a wedged exchange surfaces
+            # as SolveFailed('deadline'), never a silent hang)
+            if hasattr(self.opt, "result_exchange"):
+                self.opt.result_exchange()
+            self._sync_exchange()
+        t2 = time.perf_counter()
+        self._sync_epilogue()
+        theta = getattr(self.opt, "last_theta", None)
+        self._emit(tel.EXCHANGE_OVERLAP,
+                   staleness=staleness,
+                   issue_s=round(t1 - t0, 6),
+                   complete_s=round(t2 - t1, 6),
+                   **({} if theta is None else {"theta": float(theta)}))
 
 
 class APHHub(PHHub):

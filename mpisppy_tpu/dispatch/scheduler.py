@@ -200,6 +200,118 @@ class SolveTicket:
         return self._result
 
 
+class PlaneTicket:
+    """Fire-and-forget future over one async XLA plane dispatch — the
+    async wheel's exchange tickets (ISSUE 11; docs/async_wheel.md).
+
+    Unlike SolveTicket there is no queue to drive: XLA dispatch is
+    already asynchronous, so the dispatch ran inline at submit_plane
+    and `value` holds the (future-valued) device arrays immediately —
+    the caller threads them into later dispatches without waiting.
+    The ticket exists for the PR-8 failure semantics: result(timeout=)
+    is a BOUNDED readiness wait — past the earlier of the ticket
+    deadline and the explicit timeout it raises SolveFailed('deadline')
+    instead of pinning the caller inside a wedged device queue.  The
+    abandoned waiter thread keeps blocking until XLA returns (the same
+    'wait out the budget, then surface a typed failure' contract the
+    dispatch timeout documents; docs/dispatch.md)."""
+
+    def __init__(self, scheduler, value, label: str = "plane",
+                 deadline: float | None = None):
+        self._scheduler = scheduler
+        self.value = value
+        self.label = label
+        self._deadline = deadline     # absolute perf_counter stamp
+
+    def done(self) -> bool:
+        """Best-effort readiness probe (no blocking)."""
+        leaves = jax.tree_util.tree_leaves(self.value)
+        try:
+            return all(bool(x.is_ready()) for x in leaves
+                       if hasattr(x, "is_ready"))
+        except RuntimeError:
+            # an errored/deleted buffer: nothing left to WAIT on —
+            # result()'s landing check types the failure
+            return True
+
+    def _landed(self):
+        """The one observation point for a ready value: a dispatch
+        whose async computation ERRORED (or whose buffers died) must
+        surface here as a typed SolveFailed — never be handed back as
+        success to poison an arbitrary later use of the plane."""
+        try:
+            jax.block_until_ready(self.value)
+        except Exception as e:
+            raise SolveFailed(
+                "exception",
+                detail=f"plane ticket {self.label!r} dispatch "
+                       f"failed: {e!r}") from e
+        return self.value
+
+    def result(self, timeout: float | None = None):
+        """Block until the dispatched arrays are ready, bounded by the
+        earlier of the LIVE ticket deadline and `timeout` — expiry
+        raises SolveFailed('deadline') (and counts a plane deadline
+        miss).  After the deadline has expired, a bare result() keeps
+        raising (unless the arrays already landed), but an EXPLICIT
+        timeout grants a fresh recovery wait — exactly SolveTicket's
+        expired-deadline semantics, so a slow iteration can never
+        convert a healthy exchange into a spurious miss."""
+        now = time.perf_counter()
+        expired = self._deadline is not None and self._deadline <= now
+        bound = None if timeout is None else now + float(timeout)
+        if self._deadline is not None and not expired:
+            bound = self._deadline if bound is None \
+                else min(bound, self._deadline)
+        if bound is None and not expired:
+            return self._landed()
+        if self.done():
+            # fast path: the dispatch landed a full iteration ago in
+            # the steady state — no waiter thread, no handshake
+            return self._landed()
+        if bound is None:
+            # expired deadline, no explicit timeout, not ready
+            self._scheduler._note_plane_miss(self.label)
+            raise SolveFailed(
+                "deadline",
+                detail=f"plane ticket {self.label!r} deadline expired "
+                       f"with the dispatch still outstanding")
+        done = threading.Event()
+        err: list = []
+
+        def waiter():
+            try:
+                jax.block_until_ready(self.value)
+            except Exception as e:   # typed below, on the caller thread
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=waiter, daemon=True,
+                             name="mpisppy-tpu-plane-wait")
+        t.start()
+        if not done.wait(max(0.0, bound - time.perf_counter())):
+            # expired bound: one readiness re-check before declaring a
+            # miss — a result that LANDED before the caller got here
+            # must never read as a wedged exchange (the SolveTicket
+            # expired-deadline recovery semantics, PR-8; with an
+            # already-past deadline the 0 ms wait above loses the race
+            # against the just-started waiter thread every time)
+            if not self.done():
+                self._scheduler._note_plane_miss(self.label)
+                raise SolveFailed(
+                    "deadline",
+                    detail=f"plane ticket {self.label!r} still not "
+                           f"ready at its deadline (wedged exchange)")
+            return self._landed()
+        if err:
+            raise SolveFailed(
+                "exception",
+                detail=f"plane ticket {self.label!r} dispatch "
+                       f"failed: {err[0]!r}") from err[0]
+        return self.value
+
+
 class _Window:
     """One open coalescing window for a key: requests accumulate until
     the window is claimed by a dispatching thread and frozen."""
@@ -272,6 +384,10 @@ class SolveScheduler:
         self._quarantined_lanes = 0       # guarded-by: _lock
         self._quarantined_requests = 0    # guarded-by: _lock
         self._dispatcher_deaths = 0       # guarded-by: _lock
+        # async-wheel exchange tickets (ISSUE 11): counted here, missed
+        # deadlines noted from whichever thread timed the wait out
+        self._plane_tickets = 0           # guarded-by: _lock
+        self._plane_deadline_misses = 0   # guarded-by: _lock
         # why windows dispatched: timer (admission deadline expiry),
         # size (max_batch reached), inline (a caller's unbounded
         # result()), expedite (a deadline-bounded result()), overflow
@@ -357,6 +473,32 @@ class SolveScheduler:
                 self._expedite(win)
         return ticket
 
+    def submit_plane(self, fn, *args, label: str = "plane",
+                     deadline_s: float | None = None,
+                     **kwargs) -> PlaneTicket:
+        """Fire-and-forget ticket over one async XLA plane dispatch
+        (the async wheel's exchange programs; ISSUE 11).  `fn` is
+        called INLINE — XLA dispatch is already asynchronous, so this
+        returns immediately with the future-valued arrays in
+        ticket.value; `deadline_s` bounds any later result() wait with
+        the PR-8 typed-failure semantics."""
+        value = fn(*args, **kwargs)
+        deadline = None if deadline_s is None \
+            else time.perf_counter() + float(deadline_s)
+        with self._lock:
+            self._plane_tickets += 1
+        _metrics.REGISTRY.inc("dispatch_plane_tickets_total")
+        return PlaneTicket(self, value, label=label, deadline=deadline)
+
+    def _note_plane_miss(self, label: str) -> None:
+        """A plane ticket's bounded wait expired (PlaneTicket.result —
+        may run on any caller thread)."""
+        with self._lock:
+            self._plane_deadline_misses += 1
+        _metrics.REGISTRY.inc("dispatch_plane_deadline_misses_total")
+        self._emit_event("watchdog", component="exchange",
+                         action="deadline", label=label)
+
     def stats(self) -> dict:
         """Point-in-time snapshot for bench artifacts and the hub's
         per-sync telemetry (docs/dispatch.md field table)."""
@@ -383,6 +525,8 @@ class SolveScheduler:
                 "quarantined_lanes": self._quarantined_lanes,
                 "quarantined_requests": self._quarantined_requests,
                 "dispatcher_deaths": self._dispatcher_deaths,
+                "plane_tickets": self._plane_tickets,
+                "plane_deadline_misses": self._plane_deadline_misses,
                 "degraded": self._degraded,
                 # why windows dispatched (timer = admission deadline
                 # expiry, size = lane cap, inline/expedite = a blocking

@@ -226,6 +226,24 @@ def _fuse_wheel(cfg, hub, spokes, specs=None, tree=None):
     hub["opt_class"] = fw.FusedPH
     hub["opt_kwargs"] = dict(hub.get("opt_kwargs", {}))
     hub["opt_kwargs"]["wheel_options"] = wopts
+    # --async-staleness s >= 1: swap in the async exchange hub/driver
+    # (ISSUE 11; docs/async_wheel.md).  0 keeps the synchronous pair —
+    # AsyncPHHub/AsyncFusedPH at staleness 0 would be bit-identical
+    # anyway, but the plain classes keep the common path untouched.
+    staleness = max(0, int(cfg.get("async_staleness", 0) or 0))
+    if staleness > 0:
+        from mpisppy_tpu.algos import async_wheel as aw
+        from mpisppy_tpu.cylinders import hub as hub_mod
+        hub["hub_class"] = hub_mod.AsyncPHHub
+        hub["opt_class"] = aw.AsyncFusedPH
+        ddl = float(cfg.get("async_exchange_deadline_s", 0.0) or 0.0)
+        hub["opt_kwargs"]["async_options"] = aw.AsyncWheelOptions(
+            staleness=staleness,
+            exchange_deadline_s=ddl if ddl > 0 else None)
+        hub["hub_kwargs"] = dict(hub.get("hub_kwargs", {}))
+        hub_opts = dict(hub["hub_kwargs"].get("options", {}))
+        hub_opts["async_staleness"] = staleness
+        hub["hub_kwargs"]["options"] = hub_opts
     return hub, out_spokes
 
 
@@ -346,6 +364,12 @@ def _do_decomp(cfg, module):
             and not cfg.get("aph_hub"):
         hub, spokes = _fuse_wheel(cfg, hub, spokes, specs=specs,
                                   tree=batch.tree)
+    elif int(cfg.get("async_staleness", 0) or 0) > 0:
+        why = ("--fused-wheel is vetoed by --aph-hub/--lshaped-hub here"
+               if cfg.get("fused_wheel") else "requires --fused-wheel")
+        global_toc(f"WARNING: --async-staleness {why} "
+                   "(the async exchange plane is the fused wheel's); "
+                   "running synchronous", True)
 
     # telemetry spine (docs/telemetry.md): --trace-jsonl /
     # --metrics-snapshot build the run's event bus; the hub emits into

@@ -154,6 +154,37 @@ class DispatchFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class AsyncExchangeFault:
+    """One async-exchange fault (ISSUE 11; docs/async_wheel.md): the
+    host-side seams of the double-buffered exchange plane in
+    algos/async_wheel.AsyncFusedPH + cylinders/hub.AsyncPHHub.
+
+    kind: 'drop_plane_write' -> the due plane write is dropped (the
+                                slot keeps its previous generation, so
+                                observed staleness exceeds the bound —
+                                validity must not depend on it)
+          'torn_swap'        -> the slot gets a MIXED plane: duals and
+                                primal iterates from the OLD
+                                generation, averages from the new (a
+                                torn pointer swap)
+          'slow_harvest'     -> the host-complete half sleeps delay_s
+                                seconds (a slow host; pushed past the
+                                watchdog budget this is the wedged
+                                exchange the hub watchdog must catch)
+
+    at_iters: hub iterations to fire on; empty = every iteration."""
+
+    kind: str
+    at_iters: tuple[int, ...] = ()
+    delay_s: float = 0.05
+
+    def __post_init__(self):
+        if self.kind not in ("drop_plane_write", "torn_swap",
+                             "slow_harvest"):
+            raise ValueError(f"unknown async-exchange fault {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointFault:
     """Damage the `at_write`-th completed checkpoint file (0-based).
 
@@ -181,13 +212,14 @@ class FaultPlan:
 
     def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
                  checkpoints=(), preempt_at_iter: int | None = None,
-                 dispatches=()):
+                 dispatches=(), exchanges=()):
         self.rng = np.random.default_rng(seed)
         self.spoke_bounds = tuple(spoke_bounds)
         self.lanes = tuple(lanes)
         self.checkpoints = tuple(checkpoints)
         self.preempt_at_iter = preempt_at_iter
         self.dispatches = tuple(dispatches)
+        self.exchanges = tuple(exchanges)
         self.fired: list[tuple[str, str]] = []
         self._writes = 0
         self._first_seen: dict[int, float] = {}
@@ -218,8 +250,39 @@ class FaultPlan:
     @property
     def armed(self) -> bool:
         return bool(self.spoke_bounds or self.lanes or self.checkpoints
-                    or self.dispatches
+                    or self.dispatches or self.exchanges
                     or self.preempt_at_iter is not None)
+
+    # -- seams: async exchange (async_wheel.AsyncFusedPH / AsyncPHHub) ----
+    def filter_plane_write(self, hub_iter: int, new_plane, old_plane):
+        """Return the plane the slot should actually receive: the old
+        one (dropped write), a torn old/new mix, or the new one
+        untouched.  Host-side pointer surgery only — device arrays are
+        immutable, so a torn swap is a REF mix, never a torn tensor."""
+        for f in self.exchanges:
+            if f.at_iters and hub_iter not in f.at_iters:
+                continue
+            if f.kind == "drop_plane_write":
+                self._fire("exchange",
+                           f"drop_plane_write iter{hub_iter}")
+                return old_plane
+            if f.kind == "torn_swap":
+                self._fire("exchange", f"torn_swap iter{hub_iter}")
+                return dataclasses.replace(
+                    new_plane, W=old_plane.W, x=old_plane.x)
+        return new_plane
+
+    def before_harvest(self, hub_iter: int) -> None:
+        """Called at the top of the host-complete half; may sleep."""
+        import time as _time
+        for f in self.exchanges:
+            if f.kind != "slow_harvest":
+                continue
+            if f.at_iters and hub_iter not in f.at_iters:
+                continue
+            self._fire("exchange",
+                       f"slow_harvest {f.delay_s}s iter{hub_iter}")
+            _time.sleep(float(f.delay_s))
 
     # -- seam: spoke harvest (hub._harvest_all) ---------------------------
     def filter_bound(self, spoke_index: int, sense: str, bound: float,

@@ -104,6 +104,8 @@ class RunModel:
     kernel: dict = dataclasses.field(default_factory=dict)  # cyl -> last
     spoke_classes: dict = dataclasses.field(default_factory=dict)
     profiles: list = dataclasses.field(default_factory=list)  # profile evs
+    plane_writes: list = dataclasses.field(default_factory=list)
+    overlaps: list = dataclasses.field(default_factory=list)  # async rows
 
     def iter_of(self, it: int) -> HubIter:
         if it not in self.iters:
@@ -201,6 +203,10 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
         elif kind == ev.KERNEL_COUNTERS:
             m.kernel["hub" if r.get("cyl") in (None, "", "hub")
                      else r["cyl"]] = data
+        elif kind == ev.PLANE_WRITE:
+            m.plane_writes.append({"iter": it, **data})
+        elif kind == ev.EXCHANGE_OVERLAP:
+            m.overlaps.append({"iter": it, **data})
         elif kind == ev.PROFILE:
             m.profiles.append({"iter": it, **data})
     return m
@@ -423,6 +429,42 @@ def _resilience_summary(model: RunModel) -> dict:
     }
 
 
+def _async_wheel(model: RunModel) -> dict | None:
+    """Plane-staleness + host/device overlap attribution for an async
+    wheel run (ISSUE 11): how stale the exchange plane actually ran,
+    how the per-sync host wall split between the issue and complete
+    halves, and what fraction of the host exchange was absorbed on the
+    stale side of the pipeline."""
+    if not model.plane_writes and not model.overlaps:
+        return None
+    out: dict = {"plane_writes": len(model.plane_writes)}
+    stal = [w.get("staleness") for w in model.plane_writes
+            if isinstance(w.get("staleness"), (int, float))]
+    if stal:
+        out["staleness_mean"] = round(sum(stal) / len(stal), 3)
+        out["staleness_max"] = max(stal)
+    if model.overlaps:
+        issue = [o.get("issue_s", 0.0) or 0.0 for o in model.overlaps]
+        comp = [o.get("complete_s", 0.0) or 0.0 for o in model.overlaps]
+        thetas = [o.get("theta") for o in model.overlaps
+                  if isinstance(o.get("theta"), (int, float))]
+        total = sum(issue) + sum(comp)
+        out.update({
+            "syncs": len(model.overlaps),
+            "issue_s_total": round(sum(issue), 6),
+            "complete_s_total": round(sum(comp), 6),
+            "complete_s_med": round(_median(comp), 6),
+            # share of the host exchange running on the stale side —
+            # host work overlapping the in-flight device step
+            "overlapped_host_frac": (round(sum(comp) / total, 4)
+                                     if total > 0 else None),
+        })
+        if thetas:
+            out["theta_last"] = thetas[-1]
+            out["theta_min"] = min(thetas)
+    return out
+
+
 def _exit_info(model: RunModel) -> dict:
     if model.end is not None:
         d = dict(model.end.get("data", {}))
@@ -463,6 +505,7 @@ def analyze(model: RunModel) -> dict:
         "dispatch": _dispatch_audit(model),
         "resilience": _resilience_summary(model),
         "kernel": model.kernel,
+        "async_wheel": _async_wheel(model),
     }
     flags = []
     stall = bounds.get("iters_since_outer_moved")
@@ -639,6 +682,18 @@ def render_report(rep: dict) -> str:
                     f" ({_fmt(d.get('compiles_per_bucket'))}/bucket)"
                     f"  unexpected {d.get('unexpected_recompiles')}"
                     if d.get("buckets") is not None else ""))
+    aw = rep.get("async_wheel")
+    if aw:
+        L.append(f"async wheel: plane writes {aw.get('plane_writes')}"
+                 f"  staleness mean {_fmt(aw.get('staleness_mean'))}"
+                 f"/max {_fmt(aw.get('staleness_max'))}"
+                 + (f"  host-complete {_fmt(aw.get('complete_s_total'), '.3f')}s"
+                    f" ({_fmt(aw.get('overlapped_host_frac'))}"
+                    f" of exchange wall on the stale side)"
+                    if aw.get("syncs") else "")
+                 + (f"  theta last {_fmt(aw.get('theta_last'), '.3g')}"
+                    f"/min {_fmt(aw.get('theta_min'), '.3g')}"
+                    if aw.get("theta_last") is not None else ""))
     res = rep["resilience"]
     if any(v for v in res.values()):
         L.append(f"resilience: faults {res['faults_injected'] or '{}'}  "

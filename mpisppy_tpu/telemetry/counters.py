@@ -80,19 +80,21 @@ def record_window(kc: KernelCounters, *, active: Array, restarted: Array,
 
 
 # -- host-side harvest -------------------------------------------------------
-def harvest_state(solver_state, include_ring: bool = True) -> dict | None:
-    """Small device-to-host harvest of a PDHGState's counters (plus the
-    lane-guard totals that already live in the state).  Returns None
-    when the state carries no counters (telemetry off).
+def begin_harvest(solver_state, include_ring: bool = True):
+    """Non-blocking half of a counter harvest: slice what must be
+    sliced on device and ENQUEUE the device-to-host copies without
+    waiting for them (jax.Array.copy_to_host_async).  Returns an opaque
+    handle for complete_harvest, or None when the state carries no
+    counters (telemetry off).
 
-    include_ring=False is the per-sync hot path: only the LAST ring
-    slot is sliced on device and transferred (the hub needs one score
-    sample for the median gauge) — the full lanes x ring curve stays
-    in HBM until something actually asks for it."""
+    This is the async hub's stale-side pipeline seam (ISSUE 11
+    satellite): the hub begins a harvest right after dispatching the
+    next step and completes the PREVIOUS one, so the blocking
+    device_get in complete_harvest lands on copies that already
+    arrived instead of gating the in-flight iteration."""
     kc = getattr(solver_state, "counters", None)
     if kc is None:
         return None
-    import numpy as np
     ring_size = kc.ring.shape[-1]
     parts = [kc.iters, kc.restarts, kc.omega_adapt,
              solver_state.guard_resets, kc.ring_pos]
@@ -101,9 +103,23 @@ def harvest_state(solver_state, include_ring: bool = True) -> dict | None:
     else:
         # slice the newest slot ON DEVICE with the device-resident
         # cursor; before any window has written, the slot holds the
-        # NaN ring fill and drops out of the median below
+        # NaN ring fill and drops out of the median in complete_harvest
         slot = (kc.ring_pos - 1) % ring_size
         parts.append(jnp.take(kc.ring, slot, axis=-1))
+    for p in parts:
+        start = getattr(p, "copy_to_host_async", None)
+        if start is not None:
+            start()
+    return parts, include_ring, ring_size
+
+
+def complete_harvest(handle) -> dict | None:
+    """Blocking half: turn a begin_harvest handle into the totals dict.
+    Cheap when the enqueued copies already landed."""
+    if handle is None:
+        return None
+    import numpy as np
+    parts, include_ring, ring_size = handle
     vals = jax.device_get(parts)  # the one blocking transfer
     iters, restarts, omega, guard = vals[:4]
     pos = int(vals[4])
@@ -127,6 +143,19 @@ def harvest_state(solver_state, include_ring: bool = True) -> dict | None:
     if include_ring:
         out["residual_ring"] = ring
     return out
+
+
+def harvest_state(solver_state, include_ring: bool = True) -> dict | None:
+    """Synchronous harvest of a PDHGState's counters (plus the
+    lane-guard totals that already live in the state) — begin_harvest
+    immediately completed.  Returns None when the state carries no
+    counters (telemetry off).
+
+    include_ring=False is the per-sync hot path: only the LAST ring
+    slot is sliced on device and transferred (the hub needs one score
+    sample for the median gauge) — the full lanes x ring curve stays
+    in HBM until something actually asks for it."""
+    return complete_harvest(begin_harvest(solver_state, include_ring))
 
 
 def fold_into_registry(registry, harvested: dict, cyl: str = "hub"):

@@ -39,6 +39,12 @@ DISPATCH_QUARANTINE = "dispatch-quarantine"  # a poisoned request isolated
 WATCHDOG = "watchdog"                  # a supervisor tripped / acted
                                        # (hub progress stall, dispatcher
                                        # thread death)
+PLANE_WRITE = "plane-write"            # async hub: host wrote an
+                                       # exchange-plane slot (slot,
+                                       # generation, staleness)
+EXCHANGE_OVERLAP = "exchange-overlap"  # async hub: per-sync host
+                                       # exchange attribution (issue_s,
+                                       # complete_s, staleness, theta)
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler lifecycle: "start", or

@@ -61,6 +61,11 @@ ALL_METRICS = frozenset({
     "dispatch_quarantined_lanes_total",
     "dispatch_quarantined_requests_total",
     "dispatch_dispatcher_deaths_total",
+    "dispatch_plane_tickets_total",
+    "dispatch_plane_deadline_misses_total",
+    # async wheel exchange plane (cylinders/hub.AsyncPHHub; ISSUE 11)
+    "async_plane_writes_total",
+    "async_plane_staleness",
     # supervisors (resilience/watchdog.py)
     "watchdog_trips_total",
 })

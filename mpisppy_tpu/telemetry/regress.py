@@ -93,6 +93,11 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # with the single-buffer kernel (sweep entries key by scenario
     # count — extract_metrics rewrites list indices to S<count>)
     (r"sweep_iters_per_sec\.S100000\.iters_per_sec$", "down", 2.0),
+    # async wheel (ISSUE 11; ROADMAP item 4): wheel overhead over bare
+    # PH at staleness 1 must reach <= 1.3x (2.41x measured synchronous,
+    # BENCH_DETAIL wheel_overhead).  Ratchet: pending until witnessed
+    # on hardware, binding forever after.
+    (r"wheel_overhead_async\.overhead_factor$", "up", 1.3),
 )
 
 

@@ -220,6 +220,17 @@ class Config:
         self.add_to_config("fused_spoke_period",
                            "run fused planes every k-th iteration",
                            int, 1)
+        self.add_to_config("async_staleness",
+                           "async wheel: exchange-plane staleness bound "
+                           "(0 = synchronous hub; docs/async_wheel.md)",
+                           int, 0)
+        self.add_to_config("async_exchange_deadline_s",
+                           "async wheel: bound (seconds) on settling an "
+                           "exchange plane ticket — expiry surfaces a "
+                           "typed SolveFailed instead of a hang "
+                           "(0 = unbounded; the hub watchdog is then "
+                           "the wedged-exchange backstop)",
+                           float, 0.0)
 
     def xhatshuffle_args(self):
         """ref:config.py:676-699."""

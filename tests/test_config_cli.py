@@ -63,7 +63,9 @@ def test_vanilla_factories_run_wheel():
 
 
 @pytest.mark.parametrize("extra", [[], ["--EF"],
-                                   ["--fused-wheel", "--slammin"]])
+                                   ["--fused-wheel", "--slammin"],
+                                   ["--fused-wheel",
+                                    "--async-staleness", "1"]])
 def test_cli_end_to_end(tmp_path, extra):
     """`python -m mpisppy_tpu --module-name ...farmer` runs PH (or EF)
     end-to-end (VERDICT r1 item 10 'Done=' criterion)."""
@@ -84,4 +86,8 @@ def test_cli_end_to_end(tmp_path, extra):
                                                         rel=5e-3)
     else:
         assert payload["rel_gap"] <= 0.01
-        assert payload["inner_bound"] == pytest.approx(-108390.0, rel=5e-3)
+        # the async wheel terminates the moment the CERTIFIED 1% gap
+        # lands, so its inner incumbent is only guaranteed to that
+        # tolerance; the synchronous runs land tighter in practice
+        tol = 1.1e-2 if "--async-staleness" in extra else 5e-3
+        assert payload["inner_bound"] == pytest.approx(-108390.0, rel=tol)

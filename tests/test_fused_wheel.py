@@ -252,7 +252,14 @@ def test_scalar_pipeline_depth_shared_constant():
     import inspect
 
     assert fw.SCALAR_PIPELINE_DEPTH == 2
+    # the freshness check lives in the shared candidate policy, and
+    # both the split pipeline and the async wheel route through the
+    # one spoke-plane dispatch helper that applies it
     assert "SCALAR_PIPELINE_DEPTH" in inspect.getsource(
+        fw.FusedPH._next_xhat_cand)
+    assert "_next_xhat_cand" in inspect.getsource(
+        fw.FusedPH._dispatch_spoke_planes)
+    assert "_dispatch_spoke_planes" in inspect.getsource(
         fw.FusedPH._iterk_split)
 
 

@@ -314,10 +314,13 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     assert tel_main(["gate", r05, r06]) == 0
     assert tel_main(["gate", r05, r06, "--milestones"]) == 2
 
-    # a post-win artifact meets the floors in strict mode...
+    # a post-win artifact meets the floors in strict mode... (strict
+    # requires EVERY milestone phase present, so the synthetic post-win
+    # artifact also carries the ISSUE-11 async-overhead phase)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
+    won["parsed"]["wheel_overhead_async"] = {"overhead_factor": 1.25}
     won_path = tmp_path / "BENCH_won.json"
     won_path.write_text(json.dumps(won))
     rep2 = regress.gate_paths(r06, str(won_path), milestones=True)
