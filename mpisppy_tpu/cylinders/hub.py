@@ -73,7 +73,10 @@ class Hub(SPCommunicator):
         self.trace: list[dict] = []
         self.telemetry = self.options.get("telemetry_bus") \
             or tel.EventBus()
-        self.run_id = tel.new_run_id()
+        # a serve session passes its own id so the session's lifecycle
+        # events and its wheel's events share ONE run in the per-session
+        # trace (docs/serving.md); standalone wheels mint a fresh one
+        self.run_id = self.options.get("run_id") or tel.new_run_id()
         self._trace_view = tel.WheelTraceView(self)
         self.telemetry.subscribe(self._trace_view)
         self._last_guard_total = 0
@@ -97,6 +100,15 @@ class Hub(SPCommunicator):
             if sched is not None and plan is not None \
                     and sched.fault_plan is None:
                 sched.fault_plan = plan
+            # per-session context token (ISSUE 12 satellite): a SERVE
+            # session's hub (marked by the injected run_id) stamps its
+            # driver thread — including pre-wheel iter0 oracle work —
+            # with THIS run's id, so concurrent sessions sharing one
+            # scheduler stay joinable per session.  Standalone wheels
+            # keep the process-global stamp untouched (their run
+            # already matches the scheduler's).
+            if self.options.get("run_id"):
+                _dispatch.set_session_context(self.run_id, -1)
         except Exception:
             pass
         # hub progress watchdog (docs/resilience.md): no hub iteration
@@ -500,8 +512,13 @@ class PHHub(Hub):
         # stamp the current hub iteration onto the out-of-band emitters
         # (dispatch megabatches, fault seams) so their events join the
         # iteration timeline exactly, not by seq-window heuristics
-        # (ISSUE 5 satellite); -1 remains the pre-wheel stamp
+        # (ISSUE 5 satellite); -1 remains the pre-wheel stamp.  A
+        # serve session's hub additionally carries a per-THREAD token
+        # (run, iter) so concurrent sessions never clobber each
+        # other's stamp (see __init__)
         from mpisppy_tpu import dispatch as _dispatch
+        if self.options.get("run_id"):
+            _dispatch.set_session_context(self.run_id, self._iter)
         _dispatch.set_hub_iter(self._iter)
         plan = self.options.get("fault_plan")
         if plan is not None:
@@ -1092,6 +1109,15 @@ class AsyncPHHub(PHHub):
             return drv
         return int(mirror or 0)
 
+    def _exchange_gate(self):
+        """Context guarding the host-complete half.  The default is a
+        no-op; the serve layer's multiplexer (serve/multiplex.py)
+        overrides it with a token ring so only one session at a time
+        runs its host exchange while every other session's device
+        issue half keeps feeding the wheel — one device stream
+        advances several tenants between host exchanges."""
+        return contextlib.nullcontext()
+
     def _sync_body(self):
         staleness = self._async_staleness()
         if staleness <= 0:
@@ -1112,7 +1138,7 @@ class AsyncPHHub(PHHub):
                     "async_plane_staleness",
                     float(evd.get("staleness", 0)))
         t1 = time.perf_counter()
-        with self._span("exchange_complete"):
+        with self._span("exchange_complete"), self._exchange_gate():
             if plan is not None:
                 # chaos seam: a slow host harvest (resilience/faults
                 # AsyncExchangeFault) — the wedged-exchange case the

@@ -31,14 +31,18 @@ from mpisppy_tpu.dispatch.buckets import (   # noqa: F401
 )
 from mpisppy_tpu.dispatch.compilewatch import CompileWatch  # noqa: F401
 from mpisppy_tpu.dispatch.scheduler import (  # noqa: F401
+    DispatchContext,
     DispatchOptions,
     SolveFailed,
     SolveScheduler,
+    clear_session_context,
     configure,
+    current_context,
     current_hub_iter,
     from_cfg,
     get_scheduler,
     scheduler_stats,
     set_hub_iter,
+    set_session_context,
     solve_mip,
 )

@@ -68,18 +68,62 @@ from mpisppy_tpu.dispatch import compilewatch as _cw
 from mpisppy_tpu.telemetry import metrics as _metrics
 
 
-# -- hub-iteration stamp (ISSUE 5 satellite) --------------------------------
-# The hub calls set_hub_iter at the top of every sync; every dispatch
-# event carries the current value so the analyzer joins megabatches to
-# the iteration timeline exactly.  -1 = pre-wheel (warm-up compiles,
-# iter0 oracle work).  A plain int write/read — no lock needed for a
-# monotone diagnostic stamp.
+# -- hub-iteration stamp (ISSUE 5 satellite), generalized to a
+# per-session context token (ISSUE 12 satellite) ----------------------------
+# Single-wheel processes: the hub calls set_hub_iter at the top of every
+# sync and every dispatch event carries the value, so the analyzer joins
+# megabatches to the iteration timeline exactly.  -1 = pre-wheel
+# (warm-up compiles, iter0 oracle work).  A plain int write/read — no
+# lock needed for a monotone diagnostic stamp.
+#
+# Multi-session processes (the serve layer, docs/serving.md): several
+# concurrent wheels share one scheduler, so a single global stamp would
+# be whichever hub wrote last — garbage joins.  Each session's hub
+# instead installs a THREAD-LOCAL DispatchContext (run id + hub iter)
+# on its driver thread; submit() captures the submitting thread's token
+# per request, and the megabatch event carries a per-session breakdown
+# (`sessions`) so the analyzer joins every dispatch to the right
+# session exactly — no seq heuristics (telemetry/analyze.py keeps a
+# dispatch row whenever its sessions mention the analyzed run).
 _hub_iter = -1
+_ctx_local = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchContext:
+    """One session's dispatch stamp: the run id of the hub driving this
+    thread and its current hub iteration (-1 pre-wheel)."""
+
+    run: str = ""
+    hub_iter: int = -1
+
+
+def set_session_context(run: str, hub_iter: int = -1) -> None:
+    """Install the calling thread's session token (the hub calls this
+    each sync on its driver thread; the serve engine calls it before
+    iter0 so warm-up dispatches already join the session)."""
+    _ctx_local.ctx = DispatchContext(run=str(run), hub_iter=int(hub_iter))
+
+
+def clear_session_context() -> None:
+    _ctx_local.ctx = None
+
+
+def current_context() -> DispatchContext:
+    """The submitting thread's token; falls back to the process-global
+    hub-iteration stamp (run resolved by the scheduler's own run id)."""
+    ctx = getattr(_ctx_local, "ctx", None)
+    return ctx if ctx is not None else DispatchContext(hub_iter=_hub_iter)
 
 
 def set_hub_iter(it: int) -> None:
     global _hub_iter
     _hub_iter = int(it)
+    # a thread that carries a session token advances it in lockstep so
+    # the two stamps can never disagree on the same thread
+    ctx = getattr(_ctx_local, "ctx", None)
+    if ctx is not None:
+        _ctx_local.ctx = dataclasses.replace(ctx, hub_iter=int(it))
 
 
 def current_hub_iter() -> int:
@@ -321,7 +365,9 @@ class _Window:
 
     def __init__(self, key):
         self.key = key
-        self.reqs: list = []      # (qp, d_col, int_cols, opts, kwargs, sid)
+        # (qp, d_col, int_cols, opts, kwargs, sid, ctx) per request —
+        # ctx is the submitting thread's DispatchContext token
+        self.reqs: list = []
         self.tickets: list = []
         self.t0 = time.perf_counter()
         self.claimed = False
@@ -396,6 +442,12 @@ class SolveScheduler:
         # to admission timeouts vs size-forced dispatch (ISSUE 9
         # satellite)
         self._by_cause: dict = {}         # guarded-by: _lock
+        # per-coalesce-key occupancy breakdown (ISSUE 12 satellite):
+        # which mergeable identities actually shared megabatches, and
+        # how many distinct sessions rode each one — the attribution
+        # behind cross-session megabatch sharing in `telemetry
+        # analyze`'s dispatch audit (docs/serving.md)
+        self._by_key: dict = {}           # guarded-by: _lock
 
     # -- public API -------------------------------------------------------
     def solve_mip(self, qp, d_col, int_cols, opts=None, **kwargs):
@@ -444,7 +496,8 @@ class SolveScheduler:
             self._next_sid += 1
             ticket = SolveTicket(self, win, lanes=S, deadline=deadline,
                                  sid=sid)
-            win.reqs.append((qp, d_col, int_cols, opts, kwargs, sid))
+            win.reqs.append((qp, d_col, int_cols, opts, kwargs, sid,
+                             current_context()))
             win.tickets.append(ticket)
             full = (sum(r[0].c.shape[0] for r in win.reqs)
                     >= self.options.max_batch)
@@ -534,6 +587,20 @@ class SolveScheduler:
                 # split (a timer-heavy mix under load means the window
                 # never fills before its admission deadline)
                 "by_cause": dict(self._by_cause),
+                # per-coalesce-key occupancy: which mergeable
+                # identities shared megabatches, across how many
+                # distinct sessions (ISSUE 12 satellite)
+                "by_key": {
+                    label: {
+                        "batches": a["batches"],
+                        "lanes": a["lanes"],
+                        "pad_lanes": a["pad_lanes"],
+                        "coalesced_lanes": a["coalesced_lanes"],
+                        "occupancy": round(
+                            a["lanes"] / max(1, a["lanes"]
+                                             + a["pad_lanes"]), 4),
+                        "sessions": len(a["runs"]),
+                    } for label, a in self._by_key.items()},
             }
 
     def degrade(self) -> None:
@@ -757,7 +824,7 @@ class SolveScheduler:
                 last = e
                 continue
             self._deliver(win, reqs, tickets, res, sizes)
-            self._record(win, sizes, S_pad, sig, t_launch)
+            self._record(win, reqs, sizes, S_pad, sig, t_launch)
             return
         if len(reqs) > 1:
             # the poison is somewhere in this set: isolate by
@@ -973,10 +1040,36 @@ class SolveScheduler:
             l=cat([q.l for q in qps], 2), u=cat([q.u for q in qps], 2))
         return qp, cat(d_cols, 2)
 
-    def _record(self, win: _Window, sizes, S_pad: int, sig,
+    def _key_label(self, win: _Window) -> str:
+        """Compact stable-within-a-run render of a coalesce key for the
+        by_key stats breakdown: the human-meaningful shape/dtype parts
+        plus a short digest separating keys that only differ in shared
+        structure identity (two tenants with same-shape but different
+        shared-A problems must not fold into one row)."""
+        n, m, dtype = win.key[0], win.key[1], win.key[2]
+        digest = abs(hash(win.key)) & 0xFFFF
+        return f"n{n}m{m}:{dtype}:k{digest:04x}"
+
+    def _session_breakdown(self, reqs, sizes) -> list[dict]:
+        """Per-session (run, iter, lanes) aggregation of a megabatch's
+        requests from their captured DispatchContext tokens — the exact
+        join the analyzer uses for concurrent sessions."""
+        agg: dict[tuple, dict] = {}
+        for r, S in zip(reqs, sizes):
+            ctx = r[6]
+            a = agg.setdefault((ctx.run, ctx.hub_iter),
+                               {"run": ctx.run, "iter": ctx.hub_iter,
+                                "lanes": 0, "requests": 0})
+            a["lanes"] += S
+            a["requests"] += 1
+        return list(agg.values())
+
+    def _record(self, win: _Window, reqs, sizes, S_pad: int, sig,
                 t_launch: float):
         real = sum(sizes)
         occ = real / max(1, S_pad)
+        sessions = self._session_breakdown(reqs, sizes)
+        key_label = self._key_label(win)
         with self._lock:
             self._batches += 1
             self._lanes += real
@@ -985,6 +1078,15 @@ class SolveScheduler:
                 self._coalesced_lanes += real
             self._by_cause[win.cause] = \
                 self._by_cause.get(win.cause, 0) + 1
+            bk = self._by_key.setdefault(
+                key_label, {"batches": 0, "lanes": 0, "pad_lanes": 0,
+                            "coalesced_lanes": 0, "runs": set()})
+            bk["batches"] += 1
+            bk["lanes"] += real
+            bk["pad_lanes"] += S_pad - real
+            if len(sizes) > 1:
+                bk["coalesced_lanes"] += real
+            bk["runs"].update(s["run"] for s in sessions)
             queue_depth = sum(len(w.reqs) for w in self._pending.values())
             # snapshot everything the unlocked metric/event writes
             # below read — the renders must see one consistent point
@@ -1003,14 +1105,27 @@ class SolveScheduler:
                       dispatch_compiles)
         if self.bus is not None:
             from mpisppy_tpu import telemetry as tel
+            # the megabatch's run/iter stamp: when every riding request
+            # carries ONE session token, the event joins that session's
+            # timeline directly; a mixed (cross-tenant) batch keeps the
+            # scheduler's own run with the per-session breakdown
+            # carrying the exact attribution (ISSUE 12 satellite)
+            runs = {s["run"] for s in sessions}
+            ev_run, ev_iter = self.run, _hub_iter
+            if len(sessions) == 1 and sessions[0]["run"]:
+                ev_run = sessions[0]["run"]
+                ev_iter = sessions[0]["iter"]
             self.bus.emit(
-                tel.DISPATCH, run=self.run, cyl="dispatch",
-                hub_iter=_hub_iter,
+                tel.DISPATCH, run=ev_run, cyl="dispatch",
+                hub_iter=ev_iter,
                 requests=len(sizes), lanes=real, padded_to=S_pad,
-                occupancy=occ, bucket=list(sig[:3]),
+                occupancy=occ, bucket=list(sig[:3]), key=key_label,
                 wait_ms=1e3 * (t_launch - win.t0),
                 queue_depth=queue_depth, cause=win.cause,
-                inflight_max=inflight_max)
+                inflight_max=inflight_max,
+                **({"sessions": sessions}
+                   if any(s["run"] for s in sessions)
+                   and (len(runs) > 1 or runs != {self.run}) else {}))
 
 
 # -- the process-default scheduler (prometheus_client-style global) ---------
@@ -1039,8 +1154,10 @@ def configure(options: DispatchOptions | None = None, bus=None,
     if old is not None:
         old.close()
     # a fresh scheduler means a fresh run: drop the previous wheel's
-    # final hub-iteration stamp or the new run's warm-up dispatches
-    # would join a bogus old iteration instead of reading pre-wheel
+    # final hub-iteration stamp (and the calling thread's stale session
+    # token) or the new run's warm-up dispatches would join a bogus old
+    # iteration instead of reading pre-wheel
+    clear_session_context()
     set_hub_iter(-1)
     sched = SolveScheduler(options or DispatchOptions(), bus=bus, run=run)
     with _default_lock:

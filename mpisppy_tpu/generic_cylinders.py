@@ -247,8 +247,12 @@ def _fuse_wheel(cfg, hub, spokes, specs=None, tree=None):
     return hub, out_spokes
 
 
-def _do_decomp(cfg, module):
-    """ref:generic_cylinders.py:109-312."""
+def build_wheel(cfg, module):
+    """Assemble (hub, spokes, names, specs, batch) from a parsed Config
+    — the cylinder-construction half of the decomp driver, split out so
+    other drivers (the multi-tenant serve engine, serve/engine.py)
+    build sessions through the exact CLI recipe surface instead of a
+    parallel hand-rolled one."""
     batch, names, specs = _build_batch(cfg, module)
     converger = None
     if cfg.get("use_primal_dual_converger"):
@@ -370,6 +374,12 @@ def _do_decomp(cfg, module):
         global_toc(f"WARNING: --async-staleness {why} "
                    "(the async exchange plane is the fused wheel's); "
                    "running synchronous", True)
+    return hub, spokes, names, specs, batch
+
+
+def _do_decomp(cfg, module):
+    """ref:generic_cylinders.py:109-312."""
+    hub, spokes, names, specs, batch = build_wheel(cfg, module)
 
     # telemetry spine (docs/telemetry.md): --trace-jsonl /
     # --metrics-snapshot build the run's event bus; the hub emits into

@@ -279,20 +279,28 @@ def inparser_adder(cfg):
                       domain=int, default=5)
     cfg.add_to_config("n_clients", description="synthetic clients",
                       domain=int, default=25)
+    cfg.add_to_config("sslp_lp_relax",
+                      description="drop the integrality mask (the "
+                      "BASELINE 'sslp LP-relaxed' configuration; serve "
+                      "sessions use it for interactive-latency runs)",
+                      domain=bool, default=False)
 
 
 def kw_creator(cfg):
+    lp_relax = bool(cfg.get("sslp_lp_relax", False))
     inst = cfg.get("instance_name")
     if inst is not None and cfg.get("sslp_data_path") is not None:
         ns = int(inst.split("_")[-1])
         data_dir = os.path.join(cfg["sslp_data_path"], inst, "scenariodata")
-        return {"data_dir": data_dir, "num_scens": ns}
+        return {"data_dir": data_dir, "num_scens": ns,
+                "lp_relax": lp_relax}
     # build the synthetic instance ONCE and share it across every
     # scenario_creator call, so the dense constraint matrix exists once
     # on the host and the batch compiler's identity fast path fires
     return {"instance": synthetic_instance(cfg.get("n_servers", 5),
                                            cfg.get("n_clients", 25)),
-            "num_scens": cfg.get("num_scens")}
+            "num_scens": cfg.get("num_scens"),
+            "lp_relax": lp_relax}
 
 
 def scenario_denouement(rank, scenario_name, spec, x=None):

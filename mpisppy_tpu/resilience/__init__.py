@@ -1,5 +1,5 @@
 from mpisppy_tpu.resilience.faults import (  # noqa: F401
     CheckpointFault, DispatchFault, DispatchPoison, FaultPlan, LaneFault,
-    PreemptionError, SimulatedPreemption, SpokeBoundFault,
+    PreemptionError, ServeFault, SimulatedPreemption, SpokeBoundFault,
 )
 from mpisppy_tpu.resilience.watchdog import HubWatchdog  # noqa: F401

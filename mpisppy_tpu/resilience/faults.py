@@ -185,6 +185,45 @@ class AsyncExchangeFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeFault:
+    """One serve-layer fault (ISSUE 12; docs/serving.md): the
+    host-only seams of the multi-tenant wheel server
+    (mpisppy_tpu/serve/) and its load harness.
+
+    kind: 'hang'       -> the session's solve blocks hang_s seconds
+                          before starting (a wedged worker; the
+                          session deadline must convert it to a typed
+                          SolveFailed at the client, never a hang)
+          'poison'     -> the session's solve raises (a poisoned
+                          problem instance; the client observes a
+                          typed failure, siblings proceed)
+          'disconnect' -> the server drops the session's client
+                          connection mid-run (the session must still
+                          reach a terminal state and release its
+                          tenant quota)
+          'flood'      -> the load generator multiplies this tenant's
+                          submit count by flood_factor (admission
+                          backpressure must reject typed, and healthy
+                          tenants' latency must hold — the isolation
+                          acceptance line)
+
+    tenant: which tenant's sessions the fault fires on ("" = every
+    tenant).  at_sessions: per-tenant session ordinals (0-based, in
+    admission order) for hang/poison/disconnect; empty = every
+    session of the tenant."""
+
+    kind: str
+    tenant: str = ""
+    at_sessions: tuple[int, ...] = ()
+    hang_s: float = 3600.0
+    flood_factor: int = 10
+
+    def __post_init__(self):
+        if self.kind not in ("hang", "poison", "disconnect", "flood"):
+            raise ValueError(f"unknown serve fault {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointFault:
     """Damage the `at_write`-th completed checkpoint file (0-based).
 
@@ -212,7 +251,7 @@ class FaultPlan:
 
     def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
                  checkpoints=(), preempt_at_iter: int | None = None,
-                 dispatches=(), exchanges=()):
+                 dispatches=(), exchanges=(), serves=()):
         self.rng = np.random.default_rng(seed)
         self.spoke_bounds = tuple(spoke_bounds)
         self.lanes = tuple(lanes)
@@ -220,12 +259,14 @@ class FaultPlan:
         self.preempt_at_iter = preempt_at_iter
         self.dispatches = tuple(dispatches)
         self.exchanges = tuple(exchanges)
+        self.serves = tuple(serves)
         self.fired: list[tuple[str, str]] = []
         self._writes = 0
         self._first_seen: dict[int, float] = {}
         self._preempted = False
         self._dropped: set[int] = set()
         self._killed_dispatcher = False
+        self._served_disconnects: set[tuple[str, int]] = set()
         # set by the hub when the plan is armed in its options: every
         # injection also lands in the telemetry stream as a
         # fault-injected event (docs/telemetry.md), so a chaos run's
@@ -250,8 +291,54 @@ class FaultPlan:
     @property
     def armed(self) -> bool:
         return bool(self.spoke_bounds or self.lanes or self.checkpoints
-                    or self.dispatches or self.exchanges
+                    or self.dispatches or self.exchanges or self.serves
                     or self.preempt_at_iter is not None)
+
+    # -- seams: serve layer (mpisppy_tpu/serve; docs/serving.md) ----------
+    def _serve_hits(self, kind: str, tenant: str, ordinal: int):
+        for f in self.serves:
+            if f.kind != kind:
+                continue
+            if f.tenant and f.tenant != tenant:
+                continue
+            if f.at_sessions and ordinal not in f.at_sessions:
+                continue
+            return f
+        return None
+
+    def serve_before_solve(self, tenant: str, ordinal: int) -> None:
+        """Called by the serve engine right before a session's solve
+        starts; may sleep (hang) or raise (poison) — both must surface
+        at the client as a typed terminal outcome, never a hang."""
+        import time as _time
+        f = self._serve_hits("hang", tenant, ordinal)
+        if f is not None:
+            self._fire("serve", f"hang {tenant}#{ordinal}")
+            _time.sleep(float(f.hang_s))
+        f = self._serve_hits("poison", tenant, ordinal)
+        if f is not None:
+            self._fire("serve", f"poison {tenant}#{ordinal}")
+            raise RuntimeError(
+                f"injected serve poison ({tenant} session {ordinal})")
+
+    def serve_drop_connection(self, tenant: str, ordinal: int) -> bool:
+        """True when the server must drop this session's client
+        connection now (fires once per (tenant, ordinal))."""
+        f = self._serve_hits("disconnect", tenant, ordinal)
+        if f is None or (tenant, ordinal) in self._served_disconnects:
+            return False
+        self._served_disconnects.add((tenant, ordinal))
+        self._fire("serve", f"disconnect {tenant}#{ordinal}")
+        return True
+
+    def serve_flood_factor(self, tenant: str) -> int:
+        """Submit-count multiplier the load generator applies to this
+        tenant (1 = no flood armed)."""
+        for f in self.serves:
+            if f.kind == "flood" and (not f.tenant or f.tenant == tenant):
+                self._fire("serve", f"flood {tenant} x{f.flood_factor}")
+                return max(1, int(f.flood_factor))
+        return 1
 
     # -- seams: async exchange (async_wheel.AsyncFusedPH / AsyncPHHub) ----
     def filter_plane_write(self, hub_iter: int, new_plane, old_plane):

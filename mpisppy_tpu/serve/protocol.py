@@ -1,0 +1,139 @@
+###############################################################################
+# Serve-layer wire protocol (ISSUE 12 tentpole; docs/serving.md).
+#
+# JSON lines over a Unix or TCP socket — stdlib only, one JSON object
+# per newline-terminated line, both directions.  Client requests:
+#
+#   {"op": "submit", "tenant": "acme", "sla": "latency",
+#    "model": "farmer", "num_scens": 3, "gap_target": 0.01,
+#    "deadline_s": 120.0, "args": ["--crops-multiplier", "1"]}
+#   {"op": "ping"}
+#   {"op": "stats"}
+#
+# Server responses: one ack per request ({"ok": true, "session": sid}
+# or {"ok": false, "error": ..., "reason": ...}), then a stream of
+# per-session events scoped to THIS client's sessions:
+#
+#   {"event": "session-state", "session": sid, "state": "RUNNING", ...}
+#   {"event": "progress", "session": sid, "iter": 7, "outer": ...,
+#    "inner": ..., "rel_gap": ...}
+#   {"event": "preempted", "session": sid}            (non-terminal)
+#   {"event": "done", "session": sid, ...}            (terminal)
+#   {"event": "failed", "session": sid, "reason": ...}(terminal)
+#   {"event": "rejected", "reason": "tenant-quota", ...} (terminal)
+#
+# The terminal-outcome contract (docs/serving.md failure semantics):
+# every submitted session produces EXACTLY ONE terminal event — done,
+# failed (typed reason), or rejected — never a silent hang.  A
+# preemption mid-run emits the non-terminal "preempted" and the session
+# resumes from its checkpoint with no client-visible state loss.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import json
+
+#: SLA classes (ROADMAP items 2+5: the same server, two service
+#: classes).  latency = admission-priority interactive re-solves;
+#: throughput = batch certification runs that fill remaining capacity.
+SLA_CLASSES = ("latency", "throughput")
+
+#: models a session may request; each maps to a model module the engine
+#: builds through the generic_cylinders CLI recipe surface
+MODELS = {
+    "farmer": "mpisppy_tpu.models.farmer",
+    "sslp": "mpisppy_tpu.models.sslp",
+    "uc": "mpisppy_tpu.models.uc",
+}
+
+#: terminal client-visible events — exactly one per session
+TERMINAL_EVENTS = ("done", "failed", "rejected")
+
+
+class ProtocolError(ValueError):
+    """Malformed client request — answered with a typed error line,
+    never a dropped connection."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SubmitRequest:
+    """One validated session submission."""
+
+    tenant: str
+    sla: str = "throughput"
+    model: str = "farmer"
+    num_scens: int = 3
+    gap_target: float = 0.01
+    deadline_s: float | None = None
+    max_iterations: int = 200
+    args: tuple[str, ...] = ()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SubmitRequest":
+        if not isinstance(d, dict):
+            raise ProtocolError("submit payload must be an object")
+        tenant = d.get("tenant")
+        if not tenant or not isinstance(tenant, str):
+            raise ProtocolError("submit needs a non-empty 'tenant'")
+        sla = d.get("sla", "throughput")
+        if sla not in SLA_CLASSES:
+            raise ProtocolError(
+                f"unknown sla {sla!r} (want one of {SLA_CLASSES})")
+        model = d.get("model", "farmer")
+        if model not in MODELS:
+            raise ProtocolError(
+                f"unknown model {model!r} (want one of "
+                f"{tuple(MODELS)})")
+        try:
+            num_scens = int(d.get("num_scens", 3))
+            gap = float(d.get("gap_target", 0.01))
+            max_iters = int(d.get("max_iterations", 200))
+        except (TypeError, ValueError) as e:
+            raise ProtocolError(f"bad numeric field: {e}") from e
+        if num_scens < 1:
+            raise ProtocolError("num_scens must be >= 1")
+        if not (0.0 < gap < 1.0):
+            raise ProtocolError("gap_target must be in (0, 1)")
+        ddl = d.get("deadline_s")
+        if ddl is not None:
+            ddl = float(ddl)
+            if ddl <= 0:
+                raise ProtocolError("deadline_s must be positive")
+        args = d.get("args", ())
+        if not isinstance(args, (list, tuple)) \
+                or not all(isinstance(a, str) for a in args):
+            raise ProtocolError("'args' must be a list of strings")
+        return cls(tenant=tenant, sla=sla, model=model,
+                   num_scens=num_scens, gap_target=gap, deadline_s=ddl,
+                   max_iterations=max_iters, args=tuple(args))
+
+    def to_dict(self) -> dict:
+        return {"op": "submit", "tenant": self.tenant, "sla": self.sla,
+                "model": self.model, "num_scens": self.num_scens,
+                "gap_target": self.gap_target,
+                "deadline_s": self.deadline_s,
+                "max_iterations": self.max_iterations,
+                "args": list(self.args)}
+
+
+def encode(obj: dict) -> bytes:
+    """One wire line.  Strict JSON (non-finite floats would emit bare
+    NaN/Infinity tokens non-Python peers reject) — the same convention
+    as the JSONL trace (telemetry/events._jsonable)."""
+    from mpisppy_tpu.telemetry.events import _jsonable
+    return (json.dumps(_jsonable(obj)) + "\n").encode()
+
+
+def iter_lines(sock_file):
+    """Yield decoded JSON objects from a socket file object; a
+    malformed line yields a ProtocolError-tagged dict instead of
+    killing the reader."""
+    for raw in sock_file:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            yield json.loads(raw)
+        except ValueError:
+            yield {"_malformed": raw.decode("utf-8", "replace")
+                   if isinstance(raw, bytes) else str(raw)}

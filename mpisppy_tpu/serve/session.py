@@ -1,0 +1,215 @@
+###############################################################################
+# Session lifecycle (ISSUE 12 tentpole, piece 1; docs/serving.md).
+#
+# One Session is one tenant problem instance moving through
+#
+#     QUEUED -> ADMITTED -> RUNNING -> DONE | FAILED
+#                              ^  \
+#                              |   v
+#                           DEGRADED        (preemption-restore /
+#                                            watchdog degrade; resumes
+#                                            to RUNNING)
+#     QUEUED -> REJECTED                    (admission backpressure)
+#
+# Transitions are validated against TRANSITIONS (an illegal move is a
+# server bug and raises), and every transition is emitted as ONE
+# `session-state` event on BOTH buses: the session's own scoped bus
+# (below) and the server bus, so `telemetry watch --trace-dir` and the
+# analyzer see the same lifecycle the client streamed.
+#
+# PER-SESSION TELEMETRY SCOPING: each session owns an EventBus with a
+# JsonlSink writing trace_dir/session-<sid>.jsonl.  The session's hub
+# gets THIS bus as options['telemetry_bus'], so the whole existing
+# event taxonomy (hub-iteration / bound-accept / checkpoint-* /
+# run-end, docs/telemetry.md) lands per session with no new plumbing —
+# and a _ClientForwardSink subscriber converts the bound-progress
+# stream into the client's `progress` lines.  One wheel vocabulary,
+# three consumers (client stream, per-session trace, live watch).
+###############################################################################
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+from mpisppy_tpu import telemetry as tel
+from mpisppy_tpu.serve.protocol import SubmitRequest
+from mpisppy_tpu.telemetry import metrics as _metrics
+
+QUEUED = "QUEUED"
+ADMITTED = "ADMITTED"
+RUNNING = "RUNNING"
+DEGRADED = "DEGRADED"
+DONE = "DONE"
+FAILED = "FAILED"
+REJECTED = "REJECTED"
+
+#: legal lifecycle moves (docs/serving.md session-state table)
+TRANSITIONS = {
+    QUEUED: (ADMITTED, REJECTED, FAILED),
+    ADMITTED: (RUNNING, FAILED),
+    RUNNING: (DEGRADED, DONE, FAILED),
+    DEGRADED: (RUNNING, DONE, FAILED),
+    DONE: (),
+    FAILED: (),
+    REJECTED: (),
+}
+
+TERMINAL_STATES = (DONE, FAILED, REJECTED)
+
+_sid_counter = itertools.count()
+
+
+class _ClientForwardSink:
+    """Bus subscriber forwarding the session's bound progress and
+    terminal verdicts to its client as protocol lines.  Send failures
+    (a disconnected client) detach the outbox — the session keeps
+    running to its terminal state regardless (quota accounting and the
+    per-session trace never depend on the client still listening)."""
+
+    def __init__(self, session: "Session"):
+        self.session = session
+
+    def handle(self, event) -> None:
+        kind = event.kind
+        if kind == tel.HUB_ITERATION:
+            d = event.data
+            self.session.send({
+                "event": "progress", "session": self.session.sid,
+                "iter": d.get("iter"), "outer": d.get("outer"),
+                "inner": d.get("inner"), "rel_gap": d.get("rel_gap")})
+        elif kind == tel.CHECKPOINT_RESTORE:
+            self.session.send({
+                "event": "restored", "session": self.session.sid,
+                "iter": event.hub_iter})
+
+    def close(self) -> None:
+        pass
+
+
+class Session:
+    """One tenant problem instance: the admission unit, the telemetry
+    scope, and the terminal-outcome obligation."""
+
+    def __init__(self, spec: SubmitRequest, outbox=None,
+                 server_bus=None, trace_dir: str | None = None):
+        self.sid = f"s{next(_sid_counter):04d}"
+        self.spec = spec
+        self.tenant = spec.tenant
+        self.sla = spec.sla
+        self.ordinal = -1          # per-tenant admission ordinal
+                                   # (stamped by the admission queue)
+        self.run_id = tel.new_run_id()   # the wheel run this session IS
+        self.server_bus = server_bus
+        self.t_submit = time.perf_counter()
+        self.t_started: float | None = None
+        self.t_finished: float | None = None
+        self.deadline = None if spec.deadline_s is None \
+            else self.t_submit + float(spec.deadline_s)
+        self.restore = False       # resume from checkpoint (preemption)
+        self.preemptions = 0
+        self.checkpoint_path: str | None = None
+        # Lock discipline (tools/graftlint lock-discipline): lifecycle
+        # state and the client outbox are touched from the reader
+        # thread, the scheduler thread, the session worker, and the
+        # deadline reaper.
+        self._lock = threading.Lock()
+        self._state = QUEUED              # guarded-by: _lock
+        self._outbox = outbox             # guarded-by: _lock
+        self._terminal_sent = False       # guarded-by: _lock
+        self.outcome: dict | None = None  # guarded-by: _lock
+        # per-session telemetry scope
+        self.bus = tel.EventBus()
+        self.trace_path = None
+        if trace_dir:
+            self.trace_path = os.path.join(
+                trace_dir, f"session-{self.sid}.jsonl")
+            self.bus.subscribe(tel.JsonlSink(self.trace_path))
+        self.bus.subscribe(_ClientForwardSink(self))
+
+    # -- state machine ----------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def transition(self, new_state: str, **data) -> None:
+        """One validated lifecycle move + its session-state event on
+        both buses + the client's session-state line."""
+        with self._lock:
+            old = self._state
+            if new_state not in TRANSITIONS[old]:
+                raise RuntimeError(
+                    f"illegal session transition {old} -> {new_state} "
+                    f"({self.sid})")
+            self._state = new_state
+        payload = dict(data)
+        payload.update(session=self.sid, tenant=self.tenant,
+                       sla=self.sla, state=new_state, prev=old)
+        for bus in (self.bus, self.server_bus):
+            if bus is not None:
+                bus.emit(tel.SESSION_STATE, run=self.run_id,
+                         cyl="serve", **payload)
+        self.send({"event": "session-state", **payload})
+
+    def is_terminal(self) -> bool:
+        with self._lock:
+            return self._state in TERMINAL_STATES
+
+    # -- client stream ----------------------------------------------------
+    def send(self, msg: dict) -> bool:
+        """Best-effort line to this session's client; a dead outbox is
+        detached (the session is then 'detached' but still accounted)."""
+        with self._lock:
+            outbox = self._outbox
+        if outbox is None:
+            return False
+        try:
+            outbox(msg)
+            return True
+        except Exception:
+            with self._lock:
+                self._outbox = None
+            _metrics.REGISTRY.inc("serve_disconnects_total")
+            return False
+
+    def detach(self) -> None:
+        """Drop the client outbox (disconnect seam / closed reader)."""
+        with self._lock:
+            self._outbox = None
+
+    @property
+    def detached(self) -> bool:
+        with self._lock:
+            return self._outbox is None
+
+    # -- terminal outcomes ------------------------------------------------
+    def settle(self, event: str, **payload) -> bool:
+        """Deliver the session's ONE terminal outcome: transition to
+        the terminal state, record the outcome, send the terminal
+        protocol line exactly once, and close the session bus.  The
+        no-hang contract's last line of defense — every exit path of
+        the server worker funnels through here.  Returns True when
+        THIS call performed the delivery (False = already settled), so
+        callers can account failures exactly once."""
+        state = {"done": DONE, "failed": FAILED,
+                 "rejected": REJECTED}[event]
+        with self._lock:
+            already = self._terminal_sent
+            if not already:
+                self._terminal_sent = True
+                self.outcome = {"event": event, **payload}
+        if already:
+            return False
+        self.t_finished = time.perf_counter()
+        if self.state != state:       # REJECTED may come straight from
+            self.transition(state, **payload)   # QUEUED; others move
+        self.send({"event": event, "session": self.sid, **payload})
+        self.bus.close()
+        return True
+
+    def seconds(self) -> float | None:
+        if self.t_finished is None:
+            return None
+        return self.t_finished - self.t_submit

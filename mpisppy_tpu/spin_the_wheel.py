@@ -94,6 +94,14 @@ class WheelSpinner:
             raise
         finally:
             self._restore_preemption_handlers(prev_handlers)
+            # drop this thread's dispatch session token (ISSUE 12):
+            # the run is over — a later wheel (or bare scheduler use)
+            # on this thread must not inherit a dead run's stamp
+            try:
+                from mpisppy_tpu import dispatch as _dispatch
+                _dispatch.clear_session_context()
+            except Exception:
+                pass
         self.spcomm.send_terminate()
         self.spcomm.finalize()
         self.spcomm.hub_finalize()

@@ -28,12 +28,13 @@ from __future__ import annotations
 from mpisppy_tpu.telemetry import console, metrics
 from mpisppy_tpu.telemetry.bus import EventBus
 from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
-    BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT, CHECKPOINT_RESTORE,
-    CHECKPOINT_WRITE, CONSOLE, DISPATCH, DISPATCH_QUARANTINE,
-    DISPATCH_RETRY, EXCHANGE_OVERLAP, FAULT_INJECTED, HUB_ITERATION,
-    KERNEL_COUNTERS, LANE_QUARANTINE, PLANE_WRITE, PROFILE, RUN_END,
-    RUN_START, SPAN, SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE,
-    WATCHDOG, Event, new_run_id,
+    ADMISSION_REJECTED, BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT,
+    CHECKPOINT_RESTORE, CHECKPOINT_WRITE, CONSOLE, DISPATCH,
+    DISPATCH_QUARANTINE, DISPATCH_RETRY, EXCHANGE_OVERLAP,
+    FAULT_INJECTED, HUB_ITERATION, KERNEL_COUNTERS, LANE_QUARANTINE,
+    PLANE_WRITE, PROFILE, RUN_END, RUN_START, SESSION_STATE, SPAN,
+    SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG, Event,
+    new_run_id,
 )
 from mpisppy_tpu.telemetry.flightrec import FlightRecorder  # noqa: F401
 from mpisppy_tpu.telemetry.sinks import (  # noqa: F401

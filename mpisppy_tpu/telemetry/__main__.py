@@ -16,6 +16,9 @@
 #   watch --trace-jsonl T [--metrics-snapshot M] [--interval S] [--once]
 #       live-tail a RUNNING wheel: bound/gap, sec/iter, dispatch
 #       occupancy, quarantine counts; --once prints one snapshot.
+#   watch --trace-dir D [--interval S] [--once]
+#       live-tail a DIRECTORY of per-session traces (the serve layer
+#       writes one per session) as a per-tenant session table.
 #   compare OLD NEW [--json]
 #       diff the perf metrics of two artifacts (analyzer --json
 #       reports, device roofline reports, BENCH_DETAIL.json, or
@@ -53,9 +56,16 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="machine report instead of the human rendering")
 
     pw = sub.add_parser("watch", help="live-tail a running wheel's "
-                                      "trace + metrics snapshot")
-    pw.add_argument("--trace-jsonl", required=True,
+                                      "trace + metrics snapshot, or a "
+                                      "serve trace directory")
+    pw.add_argument("--trace-jsonl", default=None,
                     help="the running wheel's --trace-jsonl path")
+    pw.add_argument("--trace-dir", default=None,
+                    help="a directory of per-session JSONL traces "
+                         "(the serve layer writes one per session; "
+                         "docs/serving.md) — renders the per-tenant "
+                         "session table instead of the single-run "
+                         "status block")
     pw.add_argument("--metrics-snapshot", default=None,
                     help="the wheel's --metrics-snapshot file "
                          "(Prometheus text) to fold into the display")
@@ -113,6 +123,13 @@ def main(argv=None) -> int:
 
     if args.cmd == "watch":
         from mpisppy_tpu.telemetry import watch as w
+        if bool(args.trace_jsonl) == bool(args.trace_dir):
+            print("watch: need exactly one of --trace-jsonl / "
+                  "--trace-dir", file=sys.stderr)
+            return 1
+        if args.trace_dir:
+            return w.watch_dir(args.trace_dir, interval=args.interval,
+                               once=args.once)
         return w.watch(args.trace_jsonl,
                        metrics_path=args.metrics_snapshot,
                        interval=args.interval, once=args.once)

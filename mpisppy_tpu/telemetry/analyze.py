@@ -145,9 +145,22 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
         if r.get("run") != run:
             # a scheduler configured before the hub minted its run id
             # emits dispatch rows with run="" — keep them (single-wheel
-            # processes; the hub adopts the scheduler afterwards)
-            if not (r.get("kind") == ev.DISPATCH and not r.get("run")):
+            # processes; the hub adopts the scheduler afterwards).  A
+            # MIXED cross-session megabatch (serve layer) carries the
+            # scheduler's run with a per-session breakdown: keep the
+            # row when this run rode in it, joined by its own token —
+            # no seq heuristics (ISSUE 12 satellite)
+            if r.get("kind") != ev.DISPATCH:
                 continue
+            sessions = (r.get("data") or {}).get("sessions") or []
+            mine = [s for s in sessions if s.get("run") == run]
+            if r.get("run") and not mine:
+                continue
+            if mine:
+                # join at THIS session's iteration (its own token),
+                # not the foreign top-level stamp
+                r = dict(r)
+                r["iter"] = mine[0].get("iter", r.get("iter"))
         m.rows.append(r)
         kind, data, it = r.get("kind"), r.get("data", {}), r.get("iter")
         if kind == ev.RUN_START:
@@ -399,6 +412,11 @@ def _dispatch_audit(model: RunModel) -> dict | None:
         b, c = last.get("buckets"), last.get("backend_compiles")
         if b and c is not None:
             out["compiles_per_bucket"] = round(c / b, 3)
+        # per-coalesce-key occupancy (ISSUE 12 satellite): which
+        # mergeable identities shared megabatches, across how many
+        # sessions — megabatch sharing across tenants made attributable
+        if last.get("by_key"):
+            out["by_key"] = last["by_key"]
     return out
 
 

@@ -45,6 +45,14 @@ PLANE_WRITE = "plane-write"            # async hub: host wrote an
 EXCHANGE_OVERLAP = "exchange-overlap"  # async hub: per-sync host
                                        # exchange attribution (issue_s,
                                        # complete_s, staleness, theta)
+SESSION_STATE = "session-state"        # serve layer: a session moved
+                                       # through its lifecycle (QUEUED/
+                                       # ADMITTED/RUNNING/DEGRADED/
+                                       # DONE/FAILED/REJECTED)
+ADMISSION_REJECTED = "admission-rejected"  # serve layer: backpressure
+                                       # refused a submit with a typed
+                                       # reason (queue-full / quota /
+                                       # draining) — never a hang
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler lifecycle: "start", or

@@ -68,6 +68,14 @@ ALL_METRICS = frozenset({
     "async_plane_staleness",
     # supervisors (resilience/watchdog.py)
     "watchdog_trips_total",
+    # multi-tenant wheel server (mpisppy_tpu/serve; ISSUE 12)
+    "serve_sessions_total",
+    "serve_sessions_active",
+    "serve_queue_depth",
+    "serve_admission_rejects_total",
+    "serve_preemptions_total",
+    "serve_disconnects_total",
+    "serve_failures_total",
 })
 
 

@@ -68,6 +68,11 @@ GATES: tuple[tuple[str, str, float], ...] = (
     (r"(^|\.)mfu$", "down", 0.10),
     (r"device_sec_per_iter", "up", 0.10),
     (r"dma\.exposed_s$", "up", 0.25),
+    # multi-tenant serve layer (ISSUE 12; BENCH serve_load phase):
+    # client-observed latency under load and the tenant-isolation
+    # ratio regressing is a serving regression (docs/serving.md)
+    (r"serve_load\..*time_to_gap_p(50|99)_s$", "up", 0.25),
+    (r"(^|\.)isolation_ratio$", "up", 0.25),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -98,6 +103,11 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # BENCH_DETAIL wheel_overhead).  Ratchet: pending until witnessed
     # on hardware, binding forever after.
     (r"wheel_overhead_async\.overhead_factor$", "up", 1.3),
+    # multi-tenant serve (ISSUE 12 acceptance): healthy-tenant p99
+    # time-to-gap under one adversarial tenant within 25% of the
+    # no-adversary baseline — the tenant-isolation line the serve_load
+    # bench phase measures (docs/serving.md)
+    (r"serve_load\.isolation\.isolation_ratio$", "up", 1.25),
 )
 
 
@@ -234,7 +244,12 @@ def extract_metrics(obj: dict) -> dict[str, float]:
         for tgt, hit in (b.get("time_to_gap") or {}).items():
             if isinstance(hit, dict) and hit.get("seconds") is not None:
                 out[f"time_to_gap.{tgt}"] = float(hit["seconds"])
-        _flatten("dispatch", obj.get("dispatch") or {}, out)
+        disp = dict(obj.get("dispatch") or {})
+        # per-coalesce-key rows are labeled with a per-process digest
+        # (dispatch/scheduler._key_label) — never comparable across
+        # runs, so they inform the audit but not the gate
+        disp.pop("by_key", None)
+        _flatten("dispatch", disp, out)
         res = obj.get("resilience") or {}
         for k in ("dispatch_retries", "dispatch_quarantined_lanes",
                   "dispatch_quarantined_requests", "watchdog_trips",
@@ -252,8 +267,9 @@ def extract_metrics(obj: dict) -> dict[str, float]:
         out.pop("iteration.count", None)
         return out
     _flatten("", obj, out)
-    # noise keys that vary run to run without meaning anything
-    drop = re.compile(r"(t_wall|timestamp|seed|\.n$|\.rc$)")
+    # noise keys that vary run to run without meaning anything (by_key
+    # rows carry a per-process coalesce-key digest in their name)
+    drop = re.compile(r"(t_wall|timestamp|seed|\.n$|\.rc$|\.by_key\.)")
     return {k: v for k, v in out.items() if not drop.search(k)}
 
 

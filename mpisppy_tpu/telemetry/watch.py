@@ -31,6 +31,10 @@ class WatchState:
     def __init__(self):
         self.run = None
         self.hub_class = None
+        self.tenant = None          # serve layer: session-state rows
+        self.sla = None
+        self.session = None
+        self.session_state = None
         self.events = 0
         self.last_iter = None
         self.outer = self.inner = self.rel_gap = None
@@ -106,6 +110,13 @@ class WatchState:
             self.last_ckpt_wall = row.get("t_wall")
         elif kind == "run-end":
             self.end = data
+        elif kind == "session-state":
+            # serve layer (docs/serving.md): the per-session lifecycle
+            # rides the same trace; the newest state wins the display
+            self.tenant = data.get("tenant", self.tenant)
+            self.sla = data.get("sla", self.sla)
+            self.session = data.get("session", self.session)
+            self.session_state = data.get("state", self.session_state)
         elif kind == "profile":
             self.profile_dir = data.get("profile_dir", self.profile_dir)
 
@@ -216,6 +227,84 @@ def render_status(state: WatchState,
         L.append(f"profiler captures under {state.profile_dir} "
                  f"(analyze --profile-dir to inspect)")
     return "\n".join(L)
+
+
+# ---------------------------------------------------------------------------
+# directory mode (`telemetry watch --trace-dir`; ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+def _fmt_cell(v, spec=".3g", width=0):
+    s = "-" if v is None else format(v, spec)
+    return s.rjust(width) if width else s
+
+
+def render_tenant_table(states: dict[str, "WatchState"]) -> str:
+    """Per-session table over a directory of per-session traces (the
+    serve layer writes one per session; docs/serving.md), grouped by
+    tenant with a per-tenant rollup line."""
+    L: list[str] = []
+    L.append(f"{'session':<10} {'tenant':<10} {'sla':<10} {'state':<9} "
+             f"{'iter':>5} {'rel_gap':>9} {'s/iter':>8} {'events':>7}")
+    by_tenant: dict[str, list] = {}
+    for name in sorted(states):
+        st = states[name]
+        tenant = st.tenant or "?"
+        by_tenant.setdefault(tenant, []).append((name, st))
+    for tenant in sorted(by_tenant):
+        rows = by_tenant[tenant]
+        done = sum(1 for _, s in rows
+                   if s.session_state in ("DONE", "FAILED", "REJECTED"))
+        gaps = [s.rel_gap for _, s in rows if s.rel_gap is not None]
+        L.append(f"tenant {tenant}: {len(rows)} session(s), "
+                 f"{done} terminal"
+                 + (f", best rel_gap {min(gaps):.3e}" if gaps else ""))
+        for name, s in rows:
+            sid = s.session or name.replace("session-", "") \
+                .replace(".jsonl", "")
+            it = s.last_iter if isinstance(s.last_iter, int) else None
+            L.append(
+                f"  {sid:<8} {tenant:<10} {s.sla or '-':<10} "
+                f"{s.session_state or '-':<9} "
+                f"{_fmt_cell(it, 'd'):>5} "
+                f"{_fmt_cell(s.rel_gap, '.3e'):>9} "
+                f"{_fmt_cell(s.sec_per_iter, '.3g'):>8} "
+                f"{s.events:>7}")
+    if not by_tenant:
+        L.append("(no session traces yet)")
+    return "\n".join(L)
+
+
+def watch_dir(trace_dir: str, interval: float = 2.0,
+              once: bool = False, out=None) -> int:
+    """Tail a DIRECTORY of per-session JSONL traces (the serve layer
+    writes one per session) and render the per-tenant table.  New
+    files are picked up between ticks; each file keeps its own
+    incremental offset."""
+    out = out or sys.stdout
+    if not os.path.isdir(trace_dir):
+        print(f"watch: no trace directory at {trace_dir!r}",
+              file=sys.stderr)
+        return 1
+    states: dict[str, WatchState] = {}
+    offsets: dict[str, int] = {}
+    try:
+        while True:
+            try:
+                names = sorted(n for n in os.listdir(trace_dir)
+                               if n.endswith(".jsonl"))
+            except OSError:
+                names = []
+            for n in names:
+                st = states.setdefault(n, WatchState())
+                offsets[n] = _follow(os.path.join(trace_dir, n), st,
+                                     offsets.get(n, 0))
+            block = render_tenant_table(states)
+            if once:
+                print(block, file=out, flush=True)
+                return 0
+            print("\x1b[2J\x1b[H" + block, file=out, flush=True)
+            time.sleep(max(0.2, interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def watch(trace_path: str, metrics_path: str | None = None,

@@ -316,11 +316,14 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
 
     # a post-win artifact meets the floors in strict mode... (strict
     # requires EVERY milestone phase present, so the synthetic post-win
-    # artifact also carries the ISSUE-11 async-overhead phase)
+    # artifact also carries the ISSUE-11 async-overhead phase and the
+    # ISSUE-12 serve isolation phase)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
     won["parsed"]["wheel_overhead_async"] = {"overhead_factor": 1.25}
+    won["parsed"]["serve_load"] = {
+        "isolation": {"isolation_ratio": 1.0}}
     won_path = tmp_path / "BENCH_won.json"
     won_path.write_text(json.dumps(won))
     rep2 = regress.gate_paths(r06, str(won_path), milestones=True)
@@ -377,6 +380,47 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     rise_path = tmp_path / "BENCH_overlap_rise.json"
     rise_path.write_text(json.dumps(rise))
     assert regress.gate_paths(r06, str(rise_path))["ok"]
+
+
+def test_gate_r08_serve_load_keys_and_isolation_milestone(tmp_path):
+    """ISSUE 12 gate fixture: the committed r07->r08 pair gates green;
+    the serve_load latency keys gate direction-aware; and the
+    tenant-isolation ratio carries a <= 1.25 ratchet MILESTONE that
+    the committed (meeting) artifact binds."""
+    r07 = os.path.join(REPO, "BENCH_r07.json")
+    r08 = os.path.join(REPO, "BENCH_r08.json")
+    rep = regress.gate_paths(r07, r08)
+    assert rep["ok"], rep["regressions"]
+    ms = {r["metric"]: r for r in rep["milestones"]}
+    iso = ms["serve_load.isolation.isolation_ratio"]
+    assert iso["status"] == "met" and iso["milestone"] == 1.25
+
+    # the committed artifact meets the bound, so the ratchet BINDS: a
+    # later artifact slipping past 1.25 fails even within +-25%
+    slip = json.load(open(r08))
+    slip["parsed"]["serve_load"]["isolation"]["isolation_ratio"] = 1.3
+    slip_path = tmp_path / "BENCH_iso_slip.json"
+    slip_path.write_text(json.dumps(slip))
+    rep2 = regress.gate_paths(r08, str(slip_path))
+    assert not rep2["ok"]
+    assert any(r["metric"] == "serve_load.isolation.isolation_ratio"
+               for r in rep2["regressions"])
+
+    # client-observed latency keys gate at +-25%
+    slow = json.load(open(r08))
+    slow["parsed"]["serve_load"]["time_to_gap_p99_s"] *= 1.5
+    slow_path = tmp_path / "BENCH_p99_slow.json"
+    slow_path.write_text(json.dumps(slow))
+    rep3 = regress.gate_paths(r08, str(slow_path))
+    assert not rep3["ok"]
+    assert any("time_to_gap_p99_s" in r["metric"]
+               for r in rep3["regressions"])
+    # ...and a FASTER p99 passes (direction-aware)
+    fast = json.load(open(r08))
+    fast["parsed"]["serve_load"]["time_to_gap_p99_s"] *= 0.6
+    fast_path = tmp_path / "BENCH_p99_fast.json"
+    fast_path.write_text(json.dumps(fast))
+    assert regress.gate_paths(r08, str(fast_path))["ok"]
 
 
 def test_gate_analyzer_reports_and_thresholds(tmp_path):

@@ -1,0 +1,485 @@
+# Multi-tenant wheel server (ISSUE 12; docs/serving.md): admission
+# fairness + SLA ordering, typed backpressure, cross-session megabatch
+# coalescing == per-session results, the per-session dispatch context
+# token, the server end-to-end over a unix socket (real farmer wheel),
+# preempt-mid-traffic resume, and the `telemetry watch --trace-dir`
+# satellite.
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from mpisppy_tpu import dispatch
+from mpisppy_tpu.dispatch import DispatchOptions, SolveScheduler
+from mpisppy_tpu.resilience import FaultPlan, ServeFault
+from mpisppy_tpu.serve import (
+    AdmissionRejected, FairQueue, SubmitRequest, ServeOptions,
+    WheelServer,
+)
+from mpisppy_tpu.serve import loadgen, multiplex
+from mpisppy_tpu.serve.engine import SyntheticEngine, WheelEngine
+from mpisppy_tpu.serve.session import Session
+
+from test_mip_bnb import random_mips
+
+
+def _spec(tenant="acme", sla="throughput", **kw):
+    kw.setdefault("model", "farmer")
+    kw.setdefault("num_scens", 3)
+    return SubmitRequest(tenant=tenant, sla=sla, **kw)
+
+
+def _sess(tenant="acme", sla="throughput", **kw):
+    return Session(_spec(tenant, sla, **kw))
+
+
+# ---------------------------------------------------------------------------
+# admission: fairness, SLA ordering, quotas, typed backpressure
+# ---------------------------------------------------------------------------
+def test_wfq_interleaves_a_flooding_tenant():
+    """Tenant A floods 12 sessions, B submits 4: WFQ must interleave —
+    every admitted B session appears within the first 2 pops of its
+    'fair share' position, never starved behind A's backlog."""
+    q = FairQueue(max_queued=64, default_quota=99)
+    for _ in range(12):
+        q.submit(_sess("A"))
+    for _ in range(4):
+        q.submit(_sess("B"))
+    order = []
+    while True:
+        s = q.pop()
+        if s is None:
+            break
+        order.append(s.tenant)
+    # equal weights: the first 8 pops must alternate A/B until B dries
+    assert order.count("B") == 4
+    first8 = order[:8]
+    assert first8.count("B") == 4, first8
+    assert order[8:] == ["A"] * 8
+
+
+def test_wfq_weights_bias_service():
+    q = FairQueue(max_queued=64, default_quota=99,
+                  weights={"big": 3.0, "small": 1.0})
+    for _ in range(9):
+        q.submit(_sess("big"))
+        q.submit(_sess("small"))
+    first8 = [q.pop().tenant for _ in range(8)]
+    # 3:1 weights -> ~6 of the first 8 go to the heavy tenant
+    assert first8.count("big") >= 5, first8
+
+
+def test_sla_latency_class_jumps_queue_with_starvation_guard():
+    q = FairQueue(max_queued=64, default_quota=99, latency_burst=2)
+    for _ in range(4):
+        q.submit(_sess("A", "throughput"))
+    for _ in range(4):
+        q.submit(_sess("B", "latency"))
+    order = [(q.pop().sla) for _ in range(8)]
+    # latency first, but the guard forces a throughput session through
+    # after every `latency_burst` consecutive latency pops
+    assert order[0] == "latency" and order[1] == "latency"
+    assert "throughput" in order[:3 + 1], order
+    assert order.count("latency") == 4
+
+
+def test_quota_defers_and_release_resumes():
+    q = FairQueue(default_quota=1)
+    s1, s2 = _sess("A"), _sess("A")
+    q.submit(s1)
+    q.submit(s2)
+    assert q.pop() is s1
+    assert q.pop() is None          # A at quota; s2 must wait
+    q.release(s1)
+    assert q.pop() is s2
+
+
+def test_pop_discards_reaped_sessions_without_charging_wfq():
+    """A session settled terminal while queued (deadline-reaped) is
+    discarded by pop() without burning the tenant's quota/virtual
+    clock — a dead session must never cost a worker slot (review
+    fix)."""
+    q = FairQueue(default_quota=1)
+    dead, live = _sess("A"), _sess("A")
+    q.submit(dead)
+    q.submit(live)
+    dead.settle("failed", reason="deadline")
+    got = q.pop()
+    assert got is live
+    t = q.stats()["tenants"]["A"]
+    assert t["admitted"] == 1 and t["inflight"] == 1
+
+
+def test_interner_pool_is_bounded():
+    """FIFO eviction keeps the content-addressed pool bounded — an
+    evicted entry only costs coalescence, never correctness (review
+    fix)."""
+    it = multiplex.StructureInterner(max_entries=4)
+    arrays = [np.full((3, 3), float(i)) for i in range(10)]
+    for a in arrays:
+        it.intern(a)
+    st = it.stats()
+    assert st["entries"] <= 4 and st["evictions"] >= 6
+    # a still-pooled digest keeps interning to the canonical object
+    fresh = it.intern(np.full((3, 3), 9.0))
+    assert fresh is arrays[9]
+
+
+def test_backpressure_is_typed_never_a_hang():
+    q = FairQueue(max_queued=2, max_queued_per_tenant=2)
+    q.submit(_sess("A"))
+    q.submit(_sess("A"))
+    with pytest.raises(AdmissionRejected) as ei:
+        q.submit(_sess("A"))
+    assert ei.value.reason in ("queue-full", "tenant-queue-full")
+    qt = FairQueue(max_queued=50, max_queued_per_tenant=1)
+    qt.submit(_sess("A"))
+    with pytest.raises(AdmissionRejected) as ei2:
+        qt.submit(_sess("A"))
+    assert ei2.value.reason == "tenant-queue-full"
+    qt.drain()
+    with pytest.raises(AdmissionRejected) as ei3:
+        qt.submit(_sess("B"))
+    assert ei3.value.reason == "draining"
+
+
+# ---------------------------------------------------------------------------
+# cross-session coalescing == per-session results (multiplex interning)
+# ---------------------------------------------------------------------------
+def _fake_solve(qp, d_col, int_cols, opts, **kw):
+    from mpisppy_tpu.ops.bnb import BnBResult
+    time.sleep(0.002)
+    S = qp.c.shape[0]
+    return BnBResult(
+        x=jnp.zeros_like(qp.c),
+        inner=jnp.sum(qp.c, axis=-1),
+        outer=jnp.sum(qp.c, axis=-1) - 1.0,
+        gap=jnp.zeros((S,), qp.c.dtype),
+        feasible=jnp.ones((S,), bool),
+        nodes_solved=jnp.ones((S,), jnp.int32))
+
+
+def test_cross_session_coalescing_matches_per_session_results():
+    """Two 'sessions' build equal-but-distinct shared structure; after
+    interning, their concurrent submits coalesce into ONE megabatch
+    (same mergeable identity) and each session's lanes come back
+    exactly as its solo solve — coalescing is a perf transform, not a
+    semantic one."""
+    base, _, _ = random_mips(S=2, n=6, m=4)
+    # SHARED structure: one (m, n) A broadcast across lanes — the
+    # identity-keyed case the interner exists for (a batched 3-D A
+    # carries no identity and coalesces by shape alone)
+    A_shared = np.asarray(base.A)[0]
+    interner = multiplex.StructureInterner()
+
+    def session_qp(seed):
+        # each session rebuilds its own equal A (distinct object)
+        rng = np.random.default_rng(seed)
+        qp = dataclasses.replace(
+            base, A=jnp.asarray(A_shared.copy()),
+            c=jnp.asarray(rng.standard_normal((2, 6)).astype(np.float32)))
+        return multiplex.intern_qp(qp, interner=interner)
+
+    qp1, qp2 = session_qp(1), session_qp(2)
+    assert qp1.A is qp2.A, "interning must canonicalize equal A"
+    st = interner.stats()
+    assert st["hits"] >= 1
+
+    sched = SolveScheduler(
+        DispatchOptions(max_wait_ms=30.0, coalesce=True),
+        solve_fn=_fake_solve)
+    d = jnp.ones(6, jnp.float32)
+    ic = np.arange(2, dtype=np.int32)
+    t1 = sched.submit(qp1, d, ic)
+    t2 = sched.submit(qp2, d, ic)
+    r1, r2 = t1.result(), t2.result()
+    stats = sched.stats()
+    sched.close()
+    assert stats["batches"] == 1, "equal structure must coalesce"
+    assert stats["coalesced_lanes"] == 4
+    # by_key (ISSUE 12 satellite): the one shared key carries the lanes
+    assert len(stats["by_key"]) == 1
+    row = next(iter(stats["by_key"].values()))
+    assert row["lanes"] == 4 and row["coalesced_lanes"] == 4
+    np.testing.assert_allclose(np.asarray(r1.inner),
+                               np.asarray(qp1.c).sum(-1), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(r2.inner),
+                               np.asarray(qp2.c).sum(-1), atol=1e-5)
+
+    # WITHOUT interning the same submits do NOT coalesce (distinct A
+    # identity) — the control proving the interner is the mechanism
+    sched2 = SolveScheduler(
+        DispatchOptions(max_wait_ms=30.0, coalesce=True),
+        solve_fn=_fake_solve)
+    qa = dataclasses.replace(base, A=jnp.asarray(A_shared.copy()))
+    qb = dataclasses.replace(base, A=jnp.asarray(A_shared.copy()))
+    ta, tb = sched2.submit(qa, d, ic), sched2.submit(qb, d, ic)
+    ta.result(), tb.result()
+    assert sched2.stats()["batches"] == 2
+    sched2.close()
+
+
+def test_session_context_token_attributes_concurrent_sessions():
+    """Two threads with different session tokens submit concurrently:
+    the megabatch event carries the per-session breakdown, and the
+    analyzer joins each dispatch to the RIGHT session's run — no seq
+    heuristics (ISSUE 12 satellite)."""
+    from mpisppy_tpu import telemetry as tel
+    from mpisppy_tpu.telemetry import analyze as an
+
+    base, _, _ = random_mips(S=2, n=6, m=4)
+    d = jnp.ones(6, jnp.float32)
+    ic = np.arange(2, dtype=np.int32)
+    rows = []
+
+    class _Capture:
+        def handle(self, event):
+            rows.append(json.loads(event.to_json()))
+
+    bus = tel.EventBus()
+    bus.subscribe(_Capture())
+    sched = SolveScheduler(
+        DispatchOptions(max_wait_ms=20.0, coalesce=True),
+        solve_fn=_fake_solve, bus=bus, run="scheduler-run")
+    barrier = threading.Barrier(2)
+
+    def worker(run_id, it):
+        dispatch.set_session_context(run_id, it)
+        barrier.wait()
+        t = sched.submit(base, d, ic)
+        t.result()
+        dispatch.clear_session_context()
+
+    th = [threading.Thread(target=worker, args=(f"run{i}", 3 + i))
+          for i in range(2)]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join()
+    sched.close()
+    mbs = [r for r in rows if r["kind"] == "dispatch"]
+    assert mbs
+    # every lane is attributed to a session token, whichever way the
+    # two submits landed (one coalesced batch or two)
+    seen = {}
+    for r in mbs:
+        sess = r["data"].get("sessions")
+        if sess is None:
+            # single-session batch: the event's own run IS the token
+            assert r["run"] in ("run0", "run1")
+            seen[r["run"]] = r["iter"]
+        else:
+            for s in sess:
+                seen[s["run"]] = s["iter"]
+    assert seen == {"run0": 3, "run1": 4}
+
+    # analyzer join: a trace holding only these dispatch rows resolves
+    # per-session megabatches for each run
+    for i, run_id in enumerate(("run0", "run1")):
+        trace = [dict(r, kind="run-start", data={}) for r in mbs[:1]]
+        trace[0]["run"] = run_id
+        model = an.build_run_model(trace + mbs, run=run_id)
+        assert len(model.megabatches) >= 1
+        assert all(b["iter"] == 3 + i for b in model.megabatches
+                   if b.get("sessions") is None or True)
+
+
+# ---------------------------------------------------------------------------
+# server end-to-end
+# ---------------------------------------------------------------------------
+def _start_server(tmp_path, engine=None, **opt_kw):
+    opt_kw.setdefault("unix_path", str(tmp_path / "wheel.sock"))
+    opt_kw.setdefault("trace_dir", str(tmp_path / "traces"))
+    opt_kw.setdefault("spool_dir", str(tmp_path / "spool"))
+    opt_kw.setdefault("max_running", 2)
+    if engine is not None:
+        opt_kw.setdefault("multiplex", False)
+        opt_kw["engine"] = engine
+    return WheelServer(ServeOptions(**opt_kw)).start()
+
+
+def test_server_farmer_session_end_to_end(tmp_path):
+    """A real farmer wheel served over the unix socket: progress
+    events stream, the terminal outcome matches a direct wheel run,
+    and the per-session JSONL trace analyzes as that one run."""
+    from mpisppy_tpu.telemetry import analyze as an
+
+    srv = _start_server(tmp_path, multiplex=True)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=240.0)
+        rec = loadgen.run_session(cl, _spec(
+            tenant="acme", gap_target=0.01, max_iterations=150))
+        cl.close()
+    finally:
+        srv.stop()
+    assert rec["outcome"] == "done", rec
+    assert rec["time_to_gap_s"] is not None
+    trace = tmp_path / "traces" / f"session-{rec['session']}.jsonl"
+    assert trace.exists()
+    rep = an.analyze_path(str(trace))
+    assert rep["run"]["exit"]["reason"] == "converged"
+    assert rep["bounds"]["final_rel_gap"] <= 0.01 + 1e-9
+    # the session lifecycle rode the same trace
+    kinds = {json.loads(ln)["kind"]
+             for ln in trace.read_text().splitlines()}
+    assert "session-state" in kinds and "hub-iteration" in kinds
+
+    # direct (serverless) run of the same spec for the ground truth
+    eng = WheelEngine(multiplexed=True)
+    s = Session(_spec(tenant="direct", gap_target=0.01,
+                      max_iterations=150))
+    verdict, payload = eng.run(s)
+    assert verdict == "done"
+    assert payload["rel_gap"] <= 0.01 + 1e-9
+
+
+def test_typed_rejection_and_disconnect_paths(tmp_path):
+    """Backpressure answers a flood with typed rejects in the ack (the
+    client can never mistake one for a hang), and a client vanishing
+    mid-run leaves the session to its terminal state with the quota
+    restored."""
+    eng = SyntheticEngine(iters=40, step_s=0.01)
+    srv = _start_server(tmp_path, engine=eng, max_running=1,
+                        max_queued=2, max_queued_per_tenant=2)
+    try:
+        cl = loadgen.ServeClient(srv.address)
+        acks = []
+        for _ in range(6):
+            acks.append(cl.submit(_spec(tenant="flood")))
+        rejected = [a for a in acks if not a.get("ok")]
+        accepted = [a for a in acks if a.get("ok")]
+        assert rejected, "queue caps must reject typed"
+        assert all(a.get("error") == "rejected"
+                   and a.get("reason") in ("queue-full",
+                                           "tenant-queue-full")
+                   for a in rejected)
+        # disconnect mid-run: close without reading the stream
+        cl.close()
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            states = srv.stats()["states"]
+            if states.get("DONE", 0) + states.get("FAILED", 0) \
+                    >= len(accepted):
+                break
+            time.sleep(0.05)
+        states = srv.stats()["states"]
+        assert states.get("DONE", 0) >= 1
+        # quota fully restored: nothing left running or stuck
+        adm_stats = srv.stats()["admission"]["tenants"]["flood"]
+        assert adm_stats["inflight"] == 0
+    finally:
+        srv.stop()
+
+
+def test_bad_session_args_fail_typed_not_hang(tmp_path):
+    """Client-supplied session args that argparse rejects (SystemExit,
+    a BaseException) must surface as a typed terminal `failed` — not a
+    dead worker and a silent hang."""
+    srv = _start_server(tmp_path, multiplex=False, max_running=1)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=60.0)
+        rec = loadgen.run_session(cl, _spec(
+            tenant="acme", args=("--no-such-flag",)))
+        cl.close()
+    finally:
+        srv.stop()
+    assert rec["outcome"] == "failed"
+    assert rec["reason"] == "ValueError"
+
+
+def test_session_deadline_is_a_typed_failure(tmp_path):
+    """A hanging session (ServeFault hang) resolves at its deadline
+    with a typed `failed` reason=deadline — the no-hang contract."""
+    plan = FaultPlan(seed=3, serves=(
+        ServeFault("hang", tenant="acme", at_sessions=(0,),
+                   hang_s=60.0),))
+    eng = SyntheticEngine(iters=3, step_s=0.005)
+    srv = _start_server(tmp_path, engine=eng, fault_plan=plan)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=30.0)
+        rec = loadgen.run_session(cl, _spec(tenant="acme",
+                                            deadline_s=1.0))
+        cl.close()
+    finally:
+        srv.stop()
+    assert rec["outcome"] == "failed"
+    assert rec["reason"] == "deadline"
+    assert ("serve", "hang acme#0") in plan.fired
+
+
+def test_preempt_mid_traffic_resume_round_trip(tmp_path):
+    """The acceptance round trip: a SimulatedPreemption mid-run
+    emergency-saves, the session re-enters the queue DEGRADED,
+    restores from its checkpoint, and finishes with the fault-free
+    bounds — the client stream shows preempted -> restored -> done
+    with no terminal failure (no client-visible state loss)."""
+    # fault-free ground truth
+    eng = WheelEngine(multiplexed=False)
+    s0 = Session(_spec(tenant="truth", gap_target=0.01,
+                       max_iterations=150))
+    v0, base = eng.run(s0)
+    assert v0 == "done"
+
+    plan = FaultPlan(seed=5, preempt_at_iter=4)
+    srv = _start_server(tmp_path, multiplex=False, fault_plan=plan)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=240.0)
+        rec = loadgen.run_session(cl, _spec(
+            tenant="acme", gap_target=0.01, max_iterations=150))
+        cl.close()
+    finally:
+        srv.stop()
+    assert ("preemption", "iter4") in plan.fired
+    assert rec["outcome"] == "done", rec
+    assert rec["preempted"] == 1
+    # resumed run reproduces the fault-free certified bounds
+    stats = srv.stats()
+    assert stats["preemptions"] == 1
+    sess = list(srv._sessions.values())[0]
+    assert sess.outcome["event"] == "done"
+    assert sess.outcome["rel_gap"] <= 0.01 + 1e-9
+    assert sess.outcome["inner"] == pytest.approx(base["inner"],
+                                                  rel=1e-2)
+    assert sess.outcome["outer"] == pytest.approx(base["outer"],
+                                                  rel=1e-2)
+    # the trace records the preemption checkpoint round trip
+    trace = tmp_path / "traces" / f"session-{rec['session']}.jsonl"
+    kinds = [json.loads(ln)["kind"]
+             for ln in trace.read_text().splitlines()]
+    assert "checkpoint-write" in kinds
+    assert "checkpoint-restore" in kinds
+
+
+# ---------------------------------------------------------------------------
+# watch --trace-dir (satellite)
+# ---------------------------------------------------------------------------
+def test_watch_trace_dir_renders_tenant_table(tmp_path):
+    eng = SyntheticEngine(iters=5, step_s=0.002)
+    srv = _start_server(tmp_path, engine=eng)
+    try:
+        recs = loadgen.run_load(srv.address, n_clients=4,
+                                sessions_each=1,
+                                tenants=("acme", "zeta"),
+                                deadline_s=30.0)
+    finally:
+        srv.stop()
+    assert all(r["outcome"] == "done" for r in recs)
+    from mpisppy_tpu.telemetry import watch as w
+    import io
+    out = io.StringIO()
+    rc = w.watch_dir(str(tmp_path / "traces"), once=True, out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "tenant acme" in text and "tenant zeta" in text
+    assert "DONE" in text
+    # the CLI surface
+    from mpisppy_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main(["watch", "--trace-dir",
+                     str(tmp_path / "traces"), "--once"]) == 0
+    # exactly one of --trace-jsonl/--trace-dir
+    assert tel_main(["watch", "--once"]) == 1

@@ -30,6 +30,9 @@ ALLOWED_FILES = {
     "telemetry/watch.py",     # live-monitor renderer (stdout IS the
                               # product: the refreshing status block)
     "__main__.py",            # CLI entry point
+    "serve/__main__.py",      # serve-server CLI entry point (its
+                              # stdout IS the product: the bound
+                              # address + argv diagnostics)
     "parallel/_multihost_dryrun.py",  # multihost smoke entry point
     "confidence_intervals/mmw_conf.py",  # CLI entry point (JSON stdout)
     "resilience/watchdog.py",  # abort-path last words go straight to
