@@ -875,6 +875,180 @@ def bench_measured_mfu():
     return out
 
 
+def bench_wheel_scengen():
+    """ISSUE 14 acceptance: seeded on-device scenario synthesis takes
+    the wheel to S >= 1M (docs/scengen.md).  Three parts:
+
+      * synthesized-vs-materialized A/B at the max COMMON scale both
+        paths hold resident: PH iters/s on the same farmer batch as a
+        concrete ScenarioBatch vs a VirtualBatch synthesizing inside
+        the step — the ratio carries the >= 0.9 MILESTONE
+        (telemetry/regress.py): recompute-instead-of-store must cost
+        <= 10% throughput where both fit;
+      * a synthesized S sweep up to >= 1M: iters/s, resident-bytes
+        high-water estimate (program pytree + solver state) vs what
+        host materialization would keep resident, and scaling
+        efficiency (lane-throughput relative to the smallest scale);
+      * the CERTIFIED run: the fused wheel (hub + Lagrangian outer +
+        x̂ = x̄ recourse inner, one monolithic jitted step) at the top
+        scale to rel_gap <= 1% — its presence at S1000000 is itself a
+        MILESTONE (ratchet: the phase can never silently drop).
+
+    CPU-smoke scale on this container; the ratchet milestones bind the
+    numbers for the next hardware round (the PR-7 pattern)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpisppy_tpu import scengen
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+    from mpisppy_tpu.telemetry import metrics as metrics_mod
+
+    if SMOKE:
+        sweep, common_s, big_s = [64, 256], 64, 256
+    elif QUICK:
+        sweep, common_s, big_s = [4_096, 65_536], 4_096, 65_536
+    else:
+        sweep, common_s, big_s = [10_000, 100_000, 1_000_000], \
+            100_000, 1_000_000
+
+    # throughput measurements run the SWEEP-standard PH config
+    # (subproblem_windows=8, the same step every sweep_* phase times) so
+    # the A/B ratio compares synthesis against the step the rest of the
+    # bench reports; the certified 1M run below trades step weight for
+    # exchange frequency (subproblem_windows=2 certifies in fewer
+    # device-seconds on farmer)
+    sweep_opts = ph_mod.PHOptions(
+        default_rho=1.0, subproblem_windows=8, iter0_windows=20,
+        pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40,
+                              iter_precision=ITER_PRECISION))
+    ks = ph_mod.kernel_opts(sweep_opts)
+    ph_opts = ph_mod.PHOptions(
+        default_rho=1.0, subproblem_windows=2, iter0_windows=20,
+        pdhg=pdhg.PDHGOptions(tol=1e-6, restart_period=40,
+                              iter_precision=ITER_PRECISION))
+    ko = ph_mod.kernel_opts(ph_opts)
+
+    def state_bytes(st):
+        return sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.tree_util.tree_leaves(st))
+
+    def measure_ips(batch, n_iters):
+        rho = jnp.ones(batch.num_nonants, jnp.float32)
+        quick = dataclasses.replace(ks, iter0_windows=8)
+        st, _, _ = ph_mod.ph_iter0(batch, rho, quick)
+        st = ph_mod.ph_iterk(batch, st, ks)   # compile
+        jax.block_until_ready(st.conv)
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            st = ph_mod.ph_iterk(batch, st, ks)
+        jax.block_until_ready(st.conv)
+        return n_iters / (time.perf_counter() - t0), st
+
+    out = {"iter_precision": ITER_PRECISION or "bf16x6",
+           "model": "farmer", "common_scenarios": common_s}
+
+    # -- A/B at the common scale -----------------------------------------
+    n_meas = 2 if SMOKE else 3
+    prog_c = farmer.scenario_program(common_s, seed=0)
+    vb_c = scengen.virtual_batch(prog_c)
+    bm_c = scengen.materialize(prog_c)   # same bits, resident data
+    ips_mat, st_m = measure_ips(bm_c, n_meas)
+    ips_syn, _ = measure_ips(vb_c, n_meas)
+    out["materialized"] = {
+        "iters_per_sec": round(ips_mat, 4),
+        "resident_data_bytes": vb_c.materialized_bytes(),
+    }
+    out["synthesized"] = {
+        "iters_per_sec": round(ips_syn, 4),
+        "resident_data_bytes": vb_c.persistent_bytes(),
+    }
+    out["synth_vs_materialized_ratio"] = round(ips_syn / ips_mat, 4)
+    del bm_c, st_m
+
+    # -- synthesized sweep to >= 1M --------------------------------------
+    rows = []
+    base_lanes = None
+    for S in sweep:
+        prog = farmer.scenario_program(S, seed=0)
+        vb = scengen.virtual_batch(prog)
+        ips, st = measure_ips(vb, n_meas if S < 1_000_000 else 2)
+        lanes = ips * S
+        if base_lanes is None:
+            base_lanes = lanes
+        rows.append({
+            "scenarios": S,
+            "iters_per_sec": round(ips, 4),
+            "lane_iters_per_sec": round(lanes, 1),
+            "scaling_efficiency": round(lanes / base_lanes, 4),
+            "resident_bytes_synth": vb.persistent_bytes()
+            + state_bytes(st),
+            "resident_bytes_materialized_est": vb.materialized_bytes()
+            + state_bytes(st),
+        })
+        del vb, st
+    out["sweep"] = rows
+
+    # -- the certified wheel at the top scale ----------------------------
+    wopts = fw.FusedWheelOptions(lag_windows=4, xhat_windows=2,
+                                 slam_windows=0, shuffle_windows=0,
+                                 split_dispatch=False)
+    prog_b = farmer.scenario_program(big_s, seed=0)
+    vb_b = scengen.virtual_batch(prog_b)
+    rho = jnp.ones(vb_b.num_nonants, jnp.float32)
+    max_iters = 5 if SMOKE else 40
+    t0 = time.perf_counter()
+    wst, tb, cert = fw.fused_iter0(vb_b, rho, ko, wopts)
+    outer = float(tb) if bool(cert) else float("-inf")
+    inner, rel_gap, iters = float("inf"), float("inf"), 0
+    for k in range(1, max_iters + 1):
+        iters = k
+        wst = fw.fused_iterk(vb_b, wst, ko, wopts)
+        sc = dict(zip(fw.SCALAR_KEYS, np.asarray(wst.scalars)))
+        if sc["lag_certified"] > 0.5 and np.isfinite(sc["lag_bound"]):
+            outer = max(outer, float(sc["lag_bound"]))
+        if sc["xhat_feasible"] > 0.5 and np.isfinite(sc["xhat_value"]):
+            inner = min(inner, float(sc["xhat_value"]))
+        if np.isfinite(inner) and np.isfinite(outer):
+            rel_gap = (inner - outer) / max(abs(inner), abs(outer),
+                                            1e-12)
+            if rel_gap <= GAP_TARGET:
+                break
+    elapsed = time.perf_counter() - t0
+
+    def _fin(v):
+        return float(v) if np.isfinite(v) else None
+
+    out["certified_run"] = {
+        "scenarios": big_s,
+        "seconds_to_gap": round(elapsed, 3),
+        "iterations": iters,
+        "sec_per_iter": round(elapsed / max(1, iters), 6),
+        "rel_gap": _fin(rel_gap),
+        "certified": bool(rel_gap <= GAP_TARGET),
+        "outer": _fin(outer),
+        "inner": _fin(inner),
+        "resident_bytes_synth": vb_b.persistent_bytes()
+        + state_bytes(wst),
+        "resident_bytes_materialized_est": vb_b.materialized_bytes()
+        + state_bytes(wst),
+    }
+    out["metrics_snapshot"] = metrics_mod.REGISTRY.to_snapshot()
+    out["note"] = (
+        "farmer scenarios synthesized on-device from counter-based "
+        "keys (mpisppy_tpu/scengen): the A/B ratio compares PH "
+        "iters/s on the SAME bits held resident vs synthesized "
+        "in-step; the certified run is the fused wheel (hub + "
+        "Lagrangian + x-bar recourse planes) at the top scale to "
+        "rel_gap <= 1% with only the program pytree + solver state "
+        "resident")
+    return out
+
+
 def bench_serve_load():
     """ISSUE 12 acceptance: the multi-tenant wheel server under load
     (docs/serving.md).  An in-process WheelServer (unix socket) serves
@@ -989,9 +1163,15 @@ _PHASES = {
     "wheel_overhead": lambda: bench_wheel_overhead(),
     "wheel_overhead_async": lambda: bench_wheel_overhead_async(),
     "measured_mfu": lambda: bench_measured_mfu(),
+    "wheel_scengen": lambda: bench_wheel_scengen(),
     "serve_load": lambda: bench_serve_load(),
     "baseline_anchor": lambda: bench_baseline_anchor(),
 }
+
+#: per-phase subprocess timeout overrides (seconds): the scengen phase
+#: runs a certified S=1M wheel on whatever host it lands on — CPU smoke
+#: needs ~30 min of honest device work, not a larger problem
+_PHASE_TIMEOUTS = {"wheel_scengen": 5400}
 for _S in SWEEP:
     _PHASES[f"sweep_{_S}"] = (lambda S=_S: bench_sweep_one(S))
 
@@ -1059,7 +1239,8 @@ def main():
     t_start = time.time()
     detail = {}
     for phase in _PHASES:
-        detail[phase] = _run_phase_subprocess(phase)
+        detail[phase] = _run_phase_subprocess(
+            phase, timeout=_PHASE_TIMEOUTS.get(phase, 2400))
     detail["sweep_iters_per_sec"] = [
         detail.pop(f"sweep_{S}") for S in SWEEP]
     detail["bench_total_sec"] = round(time.time() - t_start, 1)

@@ -35,7 +35,7 @@ from mpisppy_tpu.algos import aph as aph_mod
 from mpisppy_tpu.algos import lagrangian as lag_mod
 from mpisppy_tpu.algos import ph as ph_mod
 from mpisppy_tpu.algos import xhat as xhat_mod
-from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.core.batch import ScenarioBatch, concretize
 from mpisppy_tpu.ops import boxqp, pdhg
 
 Array = jax.Array
@@ -352,6 +352,7 @@ def fused_iter0(batch: ScenarioBatch, rho: Array, opts: ph_mod.PHOptions,
     """PH Iter0 plus spoke-plane state init.  Both spoke solvers warm
     from the iter0 iterates (same A, so Lnorm/omega carry) — no extra
     power iterations, no cold starts."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     phst, tb, cert = ph_mod.ph_iter0(batch, rho, opts)
     solver = phst.solver
     dt = batch.qp.c.dtype
@@ -428,6 +429,7 @@ def fused_iterk(batch: ScenarioBatch, st: FusedWheelState,
     the Lagrangian bound at the fresh W and the recourse values at the
     fresh candidates (rounded x̄ / slam / shuffled scenario), each a
     fixed warm budget."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     phst = ph_mod.ph_iterk(batch, st.ph, opts)
     out = dataclasses.replace(st, ph=phst)
 
@@ -538,6 +540,7 @@ def ph_stale_step(batch: ScenarioBatch, st: ph_mod.PHState,
     the damping; deeper staleness lags the prox center further, and
     theta contracts automatically when the stale direction stops making
     projective progress.  Returns (new_state, theta)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     smooth_p = opts.smooth_p if opts.smoothed else 0.0
     qp_eff = ph_mod._prox_qp(batch, st.W, plane.xbar, st.z, st.rho,
                              smooth_p)
@@ -569,23 +572,24 @@ def ph_stale_step(batch: ScenarioBatch, st: ph_mod.PHState,
 
 @partial(jax.jit, static_argnames=("wopts", "windows"))
 def lag_plane(batch, W, solver, wopts, windows):
-    return _lag_step(batch, W, solver, wopts, windows)
+    return _lag_step(concretize(batch), W, solver, wopts, windows)
 
 
 @partial(jax.jit, static_argnames=("mode",))
 def _round_xbar(batch, xbar_nodes, mode="nearest"):
-    return xhat_mod.round_integers(batch, xbar_nodes, mode)
+    return xhat_mod.round_integers(concretize(batch), xbar_nodes, mode)
 
 
 @partial(jax.jit, static_argnames=("wopts", "windows"))
 def xhat_plane(batch, cand, solver, wopts, windows):
-    st, value, feas, dead = _eval_step(batch, cand, solver, windows, wopts,
-                                       tail=True)
+    st, value, feas, dead = _eval_step(concretize(batch), cand, solver,
+                                       windows, wopts, tail=True)
     return st, value, feas, dead
 
 
 @partial(jax.jit, static_argnames=("wopts", "windows", "sense_max"))
 def slam_plane(batch, x, solver, wopts, windows, sense_max):
+    batch = concretize(batch)
     x_non = batch.nonants(x)
     scand = xhat_mod.slam_candidate(batch, x_non, sense_max)
     st, value, feas, _ = _eval_step(batch, scand, solver, windows, wopts)
@@ -594,6 +598,7 @@ def slam_plane(batch, x, solver, wopts, windows, sense_max):
 
 @partial(jax.jit, static_argnames=("wopts", "windows"))
 def shuf_plane(batch, x, solver, sid, wopts, windows):
+    batch = concretize(batch)
     x_non = batch.nonants(x)
     fcand = xhat_mod.round_integers(batch, x_non[sid])
     st, value, feas, _ = _eval_step(batch, fcand, solver, windows, wopts)

@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu import global_toc
-from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.core.batch import ScenarioBatch, concretize
 from mpisppy_tpu.ops import boxqp, pdhg, simplex_qp
 
 Array = jax.Array
@@ -155,6 +155,7 @@ def fwph_iter(batch: ScenarioBatch, st: FWPHState,
               opts: FWPHOptions) -> FWPHState:
     """One FWPH outer iteration (Algorithm 3 lines 4-9 of Boland et al.;
     ref:mpisppy/fwph/fwph.py:147-307), fully on device."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     dt = batch.qp.c.dtype
     alpha = jnp.asarray(opts.fw_weight, dt)
     x_non0 = batch.nonants(st.x)
@@ -218,6 +219,7 @@ def fwph_init(batch: ScenarioBatch, rho: Array, opts: FWPHOptions):
     """fw_prep (ref:mpisppy/fwph/fwph.py:97-145): Iter0-style cold solves
     seed the first column, xbar, and W; the trivial bound comes from the
     dual side with a certificate (same recipe as algos/ph.ph_iter0)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     dt = batch.qp.c.dtype
     S, N = batch.num_scenarios, batch.num_nonants
     n = batch.qp.c.shape[-1]

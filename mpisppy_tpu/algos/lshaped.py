@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu import global_toc
-from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.core.batch import ScenarioBatch, concretize
 from mpisppy_tpu.ops import boxqp, pdhg
 from mpisppy_tpu.ops.boxqp import BoxQP
 
@@ -78,6 +78,7 @@ def _subproblem_cuts(batch: ScenarioBatch, xhat: Array,
 
     This one call replaces the reference's per-scenario subproblem loop
     + cut generator (ref:mpisppy/opt/lshaped.py:387-513)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     qp = batch.with_fixed_nonants(xhat)
     st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
 

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpisppy_tpu import global_toc
-from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.core.batch import ScenarioBatch, concretize
 from mpisppy_tpu.ops import pdhg
 from mpisppy_tpu.telemetry import profiler as _prof
 
@@ -160,6 +160,7 @@ def ph_iter0(batch: ScenarioBatch, rho: Array, opts: PHOptions):
     """Iter0: plain scenario solves, xbar, W seed, trivial bound
     (ref:mpisppy/phbase.py:829-946).  Returns
     (state, trivial_bound, certified)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     solver, trivial_bound, certified = iter0_solve_and_certify(
         batch, opts.iter0_windows, opts.pdhg)
     zeros = jnp.zeros((batch.num_scenarios, batch.num_nonants),
@@ -182,6 +183,7 @@ def ph_iterk(batch: ScenarioBatch, st: PHState, opts: PHOptions) -> PHState:
     refresh xbar/W/conv from the new iterates
     (ref:mpisppy/phbase.py:949-1061, with xbar computed *after* the
     solves so the returned state is self-consistent)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     smooth_p = opts.smooth_p if opts.smoothed else 0.0
     qp_eff = _prox_qp(batch, st.W, st.xbar, st.z, st.rho, smooth_p)
     solver = pdhg.solve_fixed(qp_eff, opts.subproblem_windows, opts.pdhg,
@@ -197,6 +199,7 @@ def ph_iterk(batch: ScenarioBatch, st: PHState, opts: PHOptions) -> PHState:
 @jax.jit
 def ph_eobjective(batch: ScenarioBatch, st: PHState) -> Array:
     """E[f_s(x_s)] at current iterates (ref:mpisppy/spopt.py:344-376)."""
+    batch = concretize(batch)
     return batch.expectation(batch.objective(st.solver.x))
 
 

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpisppy_tpu.core.batch import ScenarioBatch
+from mpisppy_tpu.core.batch import ScenarioBatch, concretize
 from mpisppy_tpu.ops import boxqp, pdhg
 
 Array = jax.Array
@@ -114,6 +114,7 @@ def _evaluate_warm_core(batch: ScenarioBatch, xhat: Array,
     reuse warm per-scenario solver state the same way,
     ref:mpisppy/cylinders/xhatshufflelooper_bounder.py warm Xhat_Eval).
     Returns (XhatResult, new_solver_state)."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     qp = batch.with_fixed_nonants(xhat)
     opts = dataclasses.replace(opts, detect_infeas=True)
     st = dataclasses.replace(
@@ -219,6 +220,7 @@ def _evaluate_core(batch: ScenarioBatch, xhat: Array,
     primal residual exceeding `feas_tol` as a backstop.  An infeasible
     scenario poisons only the scalar `value`, not the per-scenario
     vector — the batch is not poisoned."""
+    batch = concretize(batch)  # scengen: synthesize in-trace
     qp = batch.with_fixed_nonants(xhat)
     opts = dataclasses.replace(opts, detect_infeas=True)
     st = pdhg.solve(qp, opts, pdhg.init_state(qp, opts))
@@ -299,6 +301,7 @@ def xhat_shuffle(batch: ScenarioBatch, x_non: Array, scen_ids: Array,
     reference tries candidates one at a time across ranks; here the K
     trials batch into one (k*S)-subproblem program.
     """
+    batch = concretize(batch)  # scengen: synthesize in-trace
     cands = round_integers(batch, x_non[scen_ids])  # (k, N)
 
     def one(xhat):

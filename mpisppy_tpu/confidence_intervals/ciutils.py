@@ -75,6 +75,17 @@ def _sample_specs(module, scenario_names, cfg):
     return [module.scenario_creator(nm, **kw) for nm in scenario_names]
 
 
+def _scengen_program(module, cfg, num: int, start: int):
+    """The scengen replication program for this sample (draws from
+    fold_in(PRNGKey(scengen_seed), start + s) — layout-invariant and
+    exactly reproducible from the seed_provenance record alone), or
+    None for the legacy stream; scengen.program_from_cfg owns the
+    opt-in gate, the model-kwarg forwarding, and the audible
+    fallback (docs/scengen.md)."""
+    from mpisppy_tpu.scengen.program import program_from_cfg
+    return program_from_cfg(module, cfg, num, start=start)
+
+
 def gap_estimators(xhat_one, module, scenario_names, cfg,
                    ArRP: int = 1,
                    opts: pdhg.PDHGOptions | None = None,
@@ -120,7 +131,11 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
     import copy
     cfg = copy.deepcopy(cfg)
     cfg.quick_assign("num_scens", int, len(scenario_names))
-    specs = _sample_specs(module, scenario_names, cfg)
+    prog = _scengen_program(module, cfg, len(scenario_names), start)
+    if prog is not None:
+        specs = prog.to_specs()
+    else:
+        specs = _sample_specs(module, scenario_names, cfg)
     b = batch_mod.from_specs(specs)
 
     # solve the sampled EF for (zn_star, x*)
@@ -163,8 +178,11 @@ def gap_estimators(xhat_one, module, scenario_names, cfg,
                            relative_error=abs(obj_at_xhat) > 1)
     if verbose:
         global_toc(f"gap estimator: G={G:.6g} s={s:.6g}", True)
-    return {"G": G, "s": s, "seed": start + len(scenario_names),
-            "zn_star": float(np.dot(f_star, p)), "xstar": xstar}
+    out = {"G": G, "s": s, "seed": start + len(scenario_names),
+           "zn_star": float(np.dot(f_star, p)), "xstar": xstar}
+    if prog is not None:
+        out["seed_provenance"] = prog.provenance()
+    return out
 
 
 def gap_estimators_mstage(xhat_one, module, n_trees: int, cfg,

@@ -9,6 +9,20 @@ def confidence_config(cfg):
     cfg.add_to_config("confidence_level", "CI confidence level", float,
                       0.95)
     cfg.add_to_config("xhatpath", "path of an xhat .npy file", str, None)
+    # scengen replications (docs/scengen.md): when the model module
+    # ships a ScenarioProgram, draw every estimator/replication sample
+    # through counter-based scengen keys instead of per-scenario host
+    # numpy streams — unlimited replications, layout-invariant draws,
+    # and a seed_provenance record in the outputs.  Library default is
+    # the legacy stream (cfg.get(..., False)); CI-configured runs get
+    # scengen by default via this declaration.
+    cfg.add_to_config("use_scengen",
+                      "draw CI replications through scengen "
+                      "counter-based keys when the model has a "
+                      "ScenarioProgram", bool, True)
+    cfg.add_to_config("scengen_seed",
+                      "base seed of the scengen replication key "
+                      "stream", int, 0)
 
 
 def sequential_config(cfg):

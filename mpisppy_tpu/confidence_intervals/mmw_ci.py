@@ -37,6 +37,7 @@ class MMWConfidenceIntervals:
         """ref:mmw_ci.py:130-190."""
         start = self.start
         G = np.zeros(self.num_batches)
+        provenance = []
         # gap_estimators pins num_scens to the sample size itself
         for i in range(self.num_batches):
             names = self.module.scenario_names_creator(self.batch_size,
@@ -45,6 +46,8 @@ class MMWConfidenceIntervals:
                                          names, self.cfg)
             start = est["seed"]
             G[i] = est["G"]
+            if "seed_provenance" in est:
+                provenance.append(est["seed_provenance"])
             if self.verbose:
                 global_toc(f"Gn={G[i]:.6g} for batch {i}", True)
 
@@ -59,4 +62,9 @@ class MMWConfidenceIntervals:
             "std": s_g,
             "Glist": G.tolist(),
         }
+        if provenance:
+            # scengen replication batches (docs/scengen.md): the exact
+            # key windows every G_i was drawn from — the CI is fully
+            # reproducible from this record alone
+            self.result["seed_provenance"] = provenance
         return self.result

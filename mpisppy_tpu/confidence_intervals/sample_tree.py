@@ -35,6 +35,19 @@ class SampleSubtree:
         self.opts = opts or pdhg.PDHGOptions(tol=1e-7, max_iters=200_000)
         self.EF_obj = None
         self.ef = None
+        self.seed_provenance = None
+
+    def _scengen_program(self, num: int, kw: dict):
+        """The sampled tree's ScenarioProgram when the module ships one
+        and the cfg opts in; None falls back to the legacy node-seeded
+        RandomState path (scengen.program_from_cfg owns the gate +
+        audible fallback).  The tree's branching factors and base seed
+        come from THIS subtree, not the cfg."""
+        from mpisppy_tpu.scengen.program import program_from_cfg
+        return program_from_cfg(
+            self.module, self.cfg, num, seed=self.seed,
+            drop=("start_seed", "branching_factors"),
+            branching_factors=self.branching_factors)
 
     def run(self):
         from mpisppy_tpu.algos.ef import ExtensiveForm
@@ -46,9 +59,22 @@ class SampleSubtree:
         num = math.prod(self.branching_factors)
         names = self.module.scenario_names_creator(num)
         tree = self.module.make_tree(self.branching_factors)
+        creator = self.module.scenario_creator
+        prog = self._scengen_program(num, kw)
+        if prog is not None:
+            # draw the subtree through scengen keys: node draws fold
+            # the tree-node id into PRNGKey(self.seed) instead of
+            # seeding a RandomState per node — same node-sharing
+            # structure, layout-invariant draws, and a provenance
+            # record (docs/scengen.md)
+            from mpisppy_tpu.utils.sputils import extract_num
+            self.seed_provenance = prog.provenance()
+
+            def creator(name, **_kw):
+                return prog.spec_at(extract_num(name))
         self.ef = ExtensiveForm({"tol": self.opts.tol,
                                  "max_iters": self.opts.max_iters},
-                                names, self.module.scenario_creator, kw,
+                                names, creator, kw,
                                 tree=tree)
         if self.xhats is not None:
             # pin the leading stage slots at the given xhats

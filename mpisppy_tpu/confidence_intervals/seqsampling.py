@@ -198,9 +198,15 @@ class SeqSampling:
         else:
             t = scipy.stats.t.ppf(self.confidence_level, nk - 1)
             upper = Gk + t * sk / math.sqrt(nk) + 1.0 / math.sqrt(nk)
-        return {"T": k, "Candidate_solution": xhat_k,
-                "CI": [0.0, float(upper)], "G": Gk, "s": sk, "nk": nk,
-                "converged": converged}
+        out = {"T": k, "Candidate_solution": xhat_k,
+               "CI": [0.0, float(upper)], "G": Gk, "s": sk, "nk": nk,
+               "converged": converged}
+        if "seed_provenance" in est:
+            # scengen draws (docs/scengen.md): the final estimator's
+            # key window — with ScenCount, the whole sample sequence is
+            # reproducible from counter-based keys alone
+            out["seed_provenance"] = est["seed_provenance"]
+        return out
 
 
 class IndepScens_SeqSampling(SeqSampling):

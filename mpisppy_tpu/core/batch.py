@@ -223,11 +223,60 @@ class ScenarioBatch:
         )
 
 
+def concretize(batch):
+    """Realize a scengen VirtualBatch into a plain ScenarioBatch; a
+    ScenarioBatch passes through untouched.  Every jitted iteration
+    kernel calls this at entry, so synthesized scenario data exists
+    only as transients inside one device program (docs/scengen.md) —
+    the seam that decouples scenario count from resident memory."""
+    if getattr(batch, "is_virtual", False):
+        return batch.realize()
+    return batch
+
+
+def scale_field(name: str, val, d_row, d_col):
+    """Apply a SHARED Ruiz scaling to one qp field — the single
+    arithmetic both scengen synthesis paths share (from_specs with a
+    precomputed `scaling`, and VirtualBatch.realize in-trace), so
+    host materialization and device synthesis are bit-identical: each
+    field is converted to the working dtype FIRST and then scaled with
+    the same f32 elementwise ops, in the same order."""
+    if name == "c":
+        return val * d_col
+    if name == "q":
+        return val * d_col * d_col
+    if name in ("l", "u"):
+        return val / d_col
+    if name in ("bl", "bu"):
+        return val * d_row
+    if name == "A":
+        if hasattr(val, "vals"):  # ops.sparse.EllMatrix
+            vals = val.vals * d_row[..., :, None] * d_col[val.cols]
+            return dataclasses.replace(val, vals=vals)
+        return val * d_row[..., :, None] * d_col
+    raise ValueError(f"unknown qp field: {name}")
+
+
+def as_scaled_arrays(scaling, dtype):
+    """(d_row, d_col) of a boxqp.Scaling as working-dtype jnp arrays —
+    the shared conversion point of the template-scaling contract."""
+    d_row = jnp.asarray(np.asarray(scaling.d_row), dtype)
+    d_col = jnp.asarray(np.asarray(scaling.d_col), dtype)
+    return d_row, d_col
+
+
 def from_specs(specs: list[ScenarioSpec],
                tree: ScenarioTree | None = None,
                dtype=jnp.float32,
-               scale: bool = True) -> ScenarioBatch:
-    """Stack scenario specs into a device batch (the scenario compiler)."""
+               scale: bool = True,
+               scaling=None) -> ScenarioBatch:
+    """Stack scenario specs into a device batch (the scenario compiler).
+
+    scaling: a precomputed SHARED boxqp.Scaling (the scengen template-
+    scaling path, docs/scengen.md): Ruiz equilibration is skipped and
+    the given (d_row, d_col) are applied via scale_field's dtype-first
+    f32 arithmetic — bit-identical to what VirtualBatch.realize
+    synthesizes on device from the same ScenarioProgram."""
     if not specs:
         raise ValueError("need at least one scenario")
     n = specs[0].c.shape[0]
@@ -277,9 +326,6 @@ def from_specs(specs: list[ScenarioSpec],
         # a shared block (the sparse analog of stack()'s fallback)
         return sparse_mod.ell_from_scipy_batch(raw, dtype)
 
-    c = np.stack([np.asarray(sp.c, np.float64) for sp in specs])
-    q = np.stack([np.zeros(n) if sp.q is None else np.asarray(sp.q, np.float64)
-                  for sp in specs])
     A = stack_A()
     cones = None
     if any(sp.soc_blocks for sp in specs):
@@ -297,21 +343,56 @@ def from_specs(specs: list[ScenarioSpec],
                     "the batch, like the nonant layout)")
         cones = cones_mod.cone_spec(specs[0].A.shape[0], blocks0)
         cones_mod.validate_against_bounds(cones, stack("bl"), stack("bu"))
-    qp = BoxQP(
-        c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype),
-        A=A if not isinstance(A, np.ndarray) else jnp.asarray(A, dtype),
-        bl=jnp.asarray(stack("bl"), dtype), bu=jnp.asarray(stack("bu"), dtype),
-        l=jnp.asarray(stack("l"), dtype), u=jnp.asarray(stack("u"), dtype),
-        cones=cones,
-    )
-    if scale:
-        qp, scaling = ruiz_scale(qp)
-        d_col, d_row = scaling.d_col, scaling.d_row
+    if scaling is not None:
+        # scengen template-scaling path: fields go to the working dtype
+        # FIRST, then scale via scale_field — the same f32 arithmetic
+        # VirtualBatch.realize runs in-trace, so host materialization
+        # and device synthesis bit-match.  c/q stay sharing-aware here
+        # and broadcast to (S, n) (the kernel batch-shape contract).
+        S = len(specs)
+        raw_q = [sp.q for sp in specs]
+        if all(r is None for r in raw_q):
+            q_arr = np.zeros(n)
+        else:
+            q_arr = np.stack([np.zeros(n) if r is None
+                              else np.asarray(r, np.float64)
+                              for r in raw_q])
+        d_row_j, d_col_j = as_scaled_arrays(scaling, dtype)
+
+        def sf(name, arr):
+            if not hasattr(arr, "vals"):
+                arr = jnp.asarray(arr, dtype)
+            return scale_field(name, arr, d_row_j, d_col_j)
+
+        qp = BoxQP(
+            c=jnp.broadcast_to(sf("c", stack("c")), (S, n)),
+            q=jnp.broadcast_to(sf("q", q_arr), (S, n)),
+            A=sf("A", A),
+            bl=sf("bl", stack("bl")), bu=sf("bu", stack("bu")),
+            l=sf("l", stack("l")), u=sf("u", stack("u")),
+            cones=cones,
+        )
     else:
-        d_col = np.ones(A.shape[:-2] + (n,))
-        d_row = np.ones(A.shape[:-1])
-    d_col_j = jnp.asarray(d_col, dtype)
-    d_row_j = jnp.asarray(d_row, dtype)
+        c = np.stack([np.asarray(sp.c, np.float64) for sp in specs])
+        q = np.stack([np.zeros(n) if sp.q is None
+                      else np.asarray(sp.q, np.float64) for sp in specs])
+        qp = BoxQP(
+            c=jnp.asarray(c, dtype), q=jnp.asarray(q, dtype),
+            A=A if not isinstance(A, np.ndarray) else jnp.asarray(A, dtype),
+            bl=jnp.asarray(stack("bl"), dtype),
+            bu=jnp.asarray(stack("bu"), dtype),
+            l=jnp.asarray(stack("l"), dtype),
+            u=jnp.asarray(stack("u"), dtype),
+            cones=cones,
+        )
+        if scale:
+            qp, scaling = ruiz_scale(qp)
+            d_col, d_row = scaling.d_col, scaling.d_row
+        else:
+            d_col = np.ones(A.shape[:-2] + (n,))
+            d_row = np.ones(A.shape[:-1])
+        d_col_j = jnp.asarray(d_col, dtype)
+        d_row_j = jnp.asarray(d_row, dtype)
 
     integer = np.zeros(n, bool)
     if specs[0].integer is not None:

@@ -136,6 +136,116 @@ def scenario_creator(scenario_name: str,
     )
 
 
+# --------------------------------------------------------------------------
+# Seeded scenario synthesis (scengen branch; docs/scengen.md).
+#
+# aircond is the MULTISTAGE program of the family: demand follows a
+# clipped random walk over the tree, with one draw per NON-ROOT tree
+# node shared by every scenario through that node.  The scengen branch
+# keeps exactly that node-keyed structure but folds the node id into
+# the counter-based key — fold_in(base_key, node_idx(path)) — instead
+# of seeding a RandomState per node, so nonanticipativity of the DATA
+# is preserved by construction under any tiling or sharding.
+# --------------------------------------------------------------------------
+def scenario_program(num_scens: int, seed: int = 0, start: int = 0,
+                     branching_factors=(3, 3, 2), **kw):
+    """ScenarioProgram drawing the node demand walk through scengen
+    keys.  num_scens must equal prod(branching_factors)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import random as jrandom
+
+    from mpisppy_tpu.scengen.program import ScenarioProgram
+
+    if int(start) != 0:
+        # node keys derive from the WITHIN-TREE path (idx % prod), so a
+        # start offset would silently replay the same tree — replicate
+        # multistage samples by varying `seed` (one base key per tree,
+        # the sample_tree convention), never by windowing indices
+        raise ValueError("aircond program: replications vary `seed`, "
+                         "not `start` (node-keyed draws)")
+    kw.pop("start_seed", None)  # legacy RandomState knob; `seed` rules
+    p = {**DEFAULTS, **kw}
+    bfs = tuple(int(b) for b in branching_factors)
+    prod = int(np.prod(bfs))
+    if int(num_scens) != prod:
+        raise ValueError(f"aircond program needs num_scens == "
+                         f"prod(branching_factors) = {prod}")
+    T = len(bfs) + 1
+    bigM = p["Capacity"] * _BIGM_FACTOR
+
+    n = 4 * T
+    REG, OT, PI, NI = 0, T, 2 * T, 3 * T
+    c = np.zeros(n)
+    c[REG:REG + T] = p["RegularProdCost"]
+    c[OT:OT + T] = p["OvertimeProdCost"]
+    c[PI:PI + T] = p["InventoryCost"]
+    c[PI + T - 1] = p["LastInventoryCost"]
+    c[NI:NI + T] = p["NegInventoryCost"]
+    A = np.zeros((T, n))
+    for t in range(T):
+        A[t, REG + t] = 1.0
+        A[t, OT + t] = 1.0
+        A[t, PI + t] = -1.0
+        A[t, NI + t] = 1.0
+        if t > 0:
+            A[t, PI + t - 1] = 1.0
+            A[t, NI + t - 1] = -1.0
+    l = np.zeros(n)  # noqa: E741
+    u = np.full(n, bigM)
+    u[REG:REG + T] = p["Capacity"]
+    bl0 = np.zeros(T)
+    bl0[0] = p["starting_d"] - p["BeginInventory"]
+    nonant_idx = np.array(
+        [v for t in range(T - 1) for v in (REG + t, OT + t)], np.int32)
+
+    # static node-id arithmetic of _node_idx, per path length
+    before = []
+    for L in range(1, T):
+        b_, acc = 1, 1
+        for t in range(L - 1):
+            acc *= bfs[t]
+            b_ += acc
+        before.append(b_)
+    mu, sigma = float(p["mu_dev"]), float(p["sigma_dev"])
+    min_d, max_d = float(p["min_d"]), float(p["max_d"])
+    start_d = float(p["starting_d"])
+    begin_inv = float(p["BeginInventory"])
+
+    def sampler(base_key, idx):
+        # path digits of scenario idx (depth-first layout)
+        s = idx % prod
+        rem = prod
+        digits = []
+        for b in bfs:
+            rem = rem // b
+            digits.append(s // rem)
+            s = s % rem
+        d = jnp.asarray(start_d, jnp.float32)
+        rows = [jnp.asarray(start_d - begin_inv, jnp.float32)]
+        for t in range(1, T):
+            sid = jnp.asarray(0, jnp.int32)
+            for tt in range(t):
+                sid = digits[tt] + bfs[tt] * sid
+            node = before[t - 1] + sid
+            z = jrandom.normal(jax.random.fold_in(base_key, node), (),
+                               jnp.float32)
+            d = jnp.clip(d + mu + sigma * z, min_d, max_d)
+            rows.append(d)
+        bl = jnp.stack(rows)
+        return {"bl": bl, "bu": bl}
+
+    return ScenarioProgram(
+        name="aircond", num_scenarios=prod,
+        base_seed=int(seed), start=int(start),
+        template={"c": c, "A": A, "bl": bl0, "bu": bl0.copy(),
+                  "l": l, "u": u},
+        varying=("bl", "bu"), sampler=sampler,
+        nonant_idx=nonant_idx,
+        tree=make_tree(bfs),
+    )
+
+
 def make_tree(branching_factors=(3, 3, 2)) -> ScenarioTree:
     bfs = tuple(int(b) for b in branching_factors)
     return ScenarioTree(branching_factors=bfs,

@@ -124,6 +124,89 @@ def scenario_names_creator(num_scens: int, start: int | None = None):
     return [f"scen{i}" for i in range(start, start + num_scens)]
 
 
+# --------------------------------------------------------------------------
+# Seeded scenario synthesis (scengen branch; docs/scengen.md).
+#
+# The same model with its randomness rebased onto counter-based keys:
+# scenario s's yields are base[s % 3] plus U[0,1) noise per crop for
+# scenario groups > 0, drawn from threefry via
+# jax.random.uniform(scen_key(base_key, s)) instead of the legacy
+# RandomState(scennum + seedoffset) Mersenne stream — the draws differ
+# from the legacy branch by construction (different generator), but are
+# identical between host materialization, vmapped device synthesis,
+# tiled kernels, and any mesh sharding (the fold_in contract).
+# Farmer's randomness enters the CONSTRAINT MATRIX (yields), so this is
+# the per-scenario-A case of the program family.
+# --------------------------------------------------------------------------
+def scenario_program(num_scens: int, seed: int = 0, start: int = 0,
+                     crops_multiplier: int = 1,
+                     use_integer: bool = False):
+    """ScenarioProgram drawing farmer yields through scengen keys."""
+    import jax.numpy as jnp
+    from jax import random as jrandom
+
+    from mpisppy_tpu.scengen.program import ScenarioProgram, scen_key
+
+    k = int(crops_multiplier)
+    C = 3 * k
+    n = 4 * C
+    total_acreage = 500.0 * k
+    tile = lambda v: np.tile(v, k)  # noqa: E731
+
+    c = np.concatenate([
+        tile(_PLANTING_COST), -tile(_SUB_PRICE),
+        -tile(_SUPER_PRICE), tile(_PURCHASE_PRICE)])
+    m = 1 + 2 * C
+    # yield-free skeleton of the constraint matrix (scenario_creator's
+    # layout with the yield coefficients zeroed; the sampler scatters
+    # the drawn yields into rows [1, 1+C) and their negation into the
+    # limit rows)
+    A0 = np.zeros((m, n))
+    A0[0, :C] = 1.0
+    rows = 1 + np.arange(C)
+    A0[rows, 3 * C + np.arange(C)] = 1.0
+    A0[rows, C + np.arange(C)] = -1.0
+    A0[rows, 2 * C + np.arange(C)] = -1.0
+    rows2 = 1 + C + np.arange(C)
+    A0[rows2, C + np.arange(C)] = 1.0
+    A0[rows2, 2 * C + np.arange(C)] = 1.0
+    bl = np.full(m, -np.inf)
+    bu = np.full(m, np.inf)
+    bu[0] = total_acreage
+    bl[1:1 + C] = tile(_CATTLE_FEED)
+    bu[1 + C:1 + 2 * C] = 0.0
+    l = np.zeros(n)  # noqa: E741
+    u = np.concatenate([np.full(C, total_acreage), tile(_PRICE_QUOTA),
+                        np.full(C, np.inf), np.full(C, np.inf)])
+    integer = np.zeros(n, bool)
+    if use_integer:
+        integer[:C] = True
+
+    A0_f = jnp.asarray(A0, jnp.float32)
+    base_f = jnp.asarray(_BASE_YIELD, jnp.float32)
+    feed_rows = jnp.asarray(rows, jnp.int32)
+    limit_rows = jnp.asarray(rows2, jnp.int32)
+    acre_cols = jnp.arange(C, dtype=jnp.int32)
+
+    def sampler(base_key, idx):
+        base = jnp.tile(base_f[idx % 3], (k, 1))        # (k, 3)
+        noise = jrandom.uniform(scen_key(base_key, idx), (k, 3),
+                                jnp.float32)
+        y = (base + jnp.where(idx // 3 > 0, noise, 0.0)).reshape(-1)
+        A = A0_f.at[feed_rows, acre_cols].set(y)
+        A = A.at[limit_rows, acre_cols].set(-y)
+        return {"A": A}
+
+    return ScenarioProgram(
+        name="farmer", num_scenarios=int(num_scens),
+        base_seed=int(seed), start=int(start),
+        template={"c": c, "A": A0, "bl": bl, "bu": bu, "l": l, "u": u},
+        varying=("A",), sampler=sampler,
+        nonant_idx=np.arange(C, dtype=np.int32),
+        integer=integer if use_integer else None,
+    )
+
+
 def inparser_adder(cfg):
     cfg.num_scens_required()
     cfg.add_to_config("crops_multiplier",

@@ -262,6 +262,59 @@ def scenario_creator(scenario_name: str, data_dir: str | None = None,
     return spec
 
 
+# --------------------------------------------------------------------------
+# Seeded scenario synthesis (scengen branch; docs/scengen.md).
+#
+# sslp randomness is RHS-only (ClientPresent), so the program's varying
+# fields are just (bl, bu): the dense constraint matrix, costs, and box
+# stay one shared template for ANY scenario count — the ideal shape for
+# on-device synthesis.  ClientPresent ~ Bernoulli(1/2) per client draws
+# from threefry (uniform(scen_key(base_key, s)) < 0.5) instead of the
+# legacy RandomState stream.
+# --------------------------------------------------------------------------
+def scenario_program(num_scens: int, seed: int = 0, start: int = 0,
+                     n_servers: int = 5, n_clients: int = 25,
+                     inst_seed: int = 0, lp_relax: bool = False,
+                     instance: dict | None = None):
+    """ScenarioProgram drawing ClientPresent through scengen keys."""
+    import jax.numpy as jnp
+    from jax import random as jrandom
+
+    from mpisppy_tpu.scengen.program import ScenarioProgram, scen_key
+
+    inst = instance if instance is not None \
+        else synthetic_instance(n_servers, n_clients, inst_seed)
+    n = int(inst["NumServers"])
+    m = int(inst["NumClients"])
+    # populate the deterministic-structure cache and reuse its arrays
+    _build_spec(inst, np.zeros(m), "_scengen_template", None)
+    A, c, l, u, integer = inst["_spec_cache"]  # noqa: E741
+    nrows = A.shape[0]
+
+    bl0 = np.full(nrows, -np.inf)
+    bu0 = np.full(nrows, np.inf)
+    bu0[:n] = 0.0
+
+    bl0_f = jnp.asarray(bl0, jnp.float32)
+    bu0_f = jnp.asarray(bu0, jnp.float32)
+
+    def sampler(base_key, idx):
+        h = (jrandom.uniform(scen_key(base_key, idx), (m,),
+                             jnp.float32) < 0.5).astype(jnp.float32)
+        return {"bl": bl0_f.at[n:n + m].set(h),
+                "bu": bu0_f.at[n:n + m].set(h)}
+
+    integer_eff = np.zeros_like(integer) if lp_relax else integer
+    return ScenarioProgram(
+        name="sslp", num_scenarios=int(num_scens),
+        base_seed=int(seed), start=int(start),
+        template={"c": c, "A": A, "bl": bl0, "bu": bu0, "l": l, "u": u},
+        varying=("bl", "bu"), sampler=sampler,
+        nonant_idx=np.arange(n, dtype=np.int32),
+        integer=integer_eff,
+    )
+
+
 def scenario_names_creator(num_scens: int, start: int | None = None):
     """One-based names (ref:examples/sslp/sslp.py:55-60)."""
     start = 1 if start is None else start

@@ -262,6 +262,76 @@ def scenario_names_creator(num_scens: int, start: int | None = None):
     return [f"Scenario{i}" for i in range(start, start + num_scens)]
 
 
+# --------------------------------------------------------------------------
+# Seeded scenario synthesis (scengen branch; docs/scengen.md).
+#
+# uc randomness is RHS-only (hourly demand): the sparse shared A stays
+# one ELL block for any scenario count and the program varies (bl, bu).
+# The AR(1) demand noise eps_t = 0.6 eps_{t-1} + z_t is expressed in
+# closed form as a lower-triangular weight sum over the i.i.d. normals
+# (eps = sum_j 0.6^{t-j} z_j), drawn from threefry — elementwise ops
+# only, so vmapped synthesis bit-matches the per-scenario host path.
+# --------------------------------------------------------------------------
+def scenario_program(num_scens: int, seed: int = 0, start: int = 0,
+                     n_gens: int = 10, n_hours: int = 24,
+                     inst_seed: int = 0, lp_relax: bool = True,
+                     instance: dict | None = None):
+    """ScenarioProgram drawing the demand path through scengen keys."""
+    import jax.numpy as jnp
+    from jax import random as jrandom
+
+    from mpisppy_tpu.scengen.program import ScenarioProgram, scen_key
+
+    inst = instance if instance is not None \
+        else synthetic_instance(n_gens, n_hours, inst_seed)
+    A, c, l, u, integer, nonant_idx, bal0, rsv0, m = \
+        _shared_structure(inst)
+    G, T = inst["n_gens"], inst["n_hours"]
+
+    # deterministic bound skeleton (scenario_creator with the demand
+    # rows left for the sampler)
+    bl0 = np.full(m, -np.inf)
+    bu0 = np.zeros(m)
+    rr = bal0 + T
+    for g in range(G):
+        bu0[rr:rr + 2 * (T - 1)] = inst["ramp"][g]
+        rr += 2 * (T - 1)
+    nU = G * T
+    bl0[rr:rr + nU] = 0.0
+    md0 = rr + nU + nU
+    bu0[md0:md0 + nU] = 1.0
+
+    bl0_f = jnp.asarray(bl0, jnp.float32)
+    bu0_f = jnp.asarray(bu0, jnp.float32)
+    profile_f = jnp.asarray(inst["profile"], jnp.float32)
+    # AR(1) unrolled: weights[t, j] = 0.6^(t-j) for j <= t
+    t_ix = np.arange(T)
+    W_ar = np.where(t_ix[None, :] <= t_ix[:, None],
+                    0.6 ** (t_ix[:, None] - t_ix[None, :]), 0.0)
+    W_ar_f = jnp.asarray(W_ar, jnp.float32)
+    rsv_fac = float(1.0 + inst["reserve_frac"])
+
+    def sampler(base_key, idx):
+        z = jrandom.normal(scen_key(base_key, idx), (T,),
+                           jnp.float32) * 0.05
+        eps = jnp.sum(W_ar_f * z[None, :], axis=-1)
+        d = profile_f * (1.0 + eps)
+        bl = bl0_f.at[bal0:bal0 + T].set(d)
+        bu = bu0_f.at[bal0:bal0 + T].set(d)
+        bu = bu.at[rsv0:rsv0 + T].set(-rsv_fac * d)
+        return {"bl": bl, "bu": bu}
+
+    integer_eff = np.zeros_like(integer) if lp_relax else integer
+    return ScenarioProgram(
+        name="uc", num_scenarios=int(num_scens),
+        base_seed=int(seed), start=int(start),
+        template={"c": c, "A": A, "bl": bl0, "bu": bu0, "l": l, "u": u},
+        varying=("bl", "bu"), sampler=sampler,
+        nonant_idx=np.asarray(nonant_idx, np.int32),
+        integer=integer_eff,
+    )
+
+
 def inparser_adder(cfg):
     cfg.num_scens_required()
     cfg.add_to_config("uc_n_gens", "number of thermal units", int, 10)

@@ -1026,6 +1026,54 @@ def lns_repair(qp: BoxQP, d_col: Array, int_cols: Array,
             jnp.asarray(best_x, dt), jnp.asarray(feas))
 
 
+def root_state(qp: BoxQP, d_col: Array, int_cols: Array,
+               opts: BnBOptions = BnBOptions(),
+               incumbent: Array | None = None,
+               x_inc: Array | None = None,
+               warm: "tuple | None" = None) -> BnBState:
+    """Root-node BnBState: the open pool seeded with the integer root
+    box, everything else at its no-information sentinel.  THE one
+    construction shared by solve_mip (which seeds incumbent/warm from
+    its dive/pump passes) and the multichip dry run (cold defaults) —
+    the pool-seeding convention must never fork between the real
+    solver and the coverage probe.
+
+    warm: optional (x, y, omega, Lnorm); cold defaults otherwise.
+    """
+    S, n = qp.c.shape
+    dt = qp.c.dtype
+    int_cols_np = np.asarray(int_cols)
+    nI = int(int_cols_np.shape[0])
+    P = opts.pool_size
+    lo0, hi0 = _root_bounds(qp, d_col, int_cols_np)
+    if warm is None:
+        from mpisppy_tpu.ops import pdhg as _pdhg
+        x_w = jnp.clip(jnp.zeros_like(qp.c), qp.l, qp.u)
+        y_w = jnp.zeros((S, qp.m), dt)
+        omega = jnp.ones((S,), dt)
+        Lnorm = _pdhg.estimate_norm(qp).astype(dt)
+    else:
+        x_w, y_w, omega, Lnorm = warm
+    return BnBState(
+        pool_lo=jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
+            jnp.asarray(lo0, dt)),
+        pool_hi=jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
+            jnp.asarray(hi0, dt)),
+        pool_bound=jnp.full((S, P), jnp.inf, dt).at[:, 0].set(-jnp.inf),
+        pool_active=jnp.zeros((S, P), bool).at[:, 0].set(True),
+        pool_depth=jnp.zeros((S, P), jnp.int32),
+        incumbent=(jnp.full((S,), jnp.inf, dt) if incumbent is None
+                   else incumbent),
+        x_inc=(jnp.zeros((S, n), dt) if x_inc is None else x_inc),
+        fathom_floor=jnp.full((S,), jnp.inf, dt),
+        lost_bound=jnp.full((S,), jnp.inf, dt),
+        x_warm=x_w, y_warm=y_w, omega_warm=omega, Lnorm=Lnorm,
+        outer=jnp.full((S,), -jnp.inf, dt),
+        done=jnp.zeros((S,), bool),
+        nodes_solved=jnp.zeros((S,), jnp.int32),
+    )
+
+
 def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
               opts: BnBOptions = BnBOptions(),
               x_warm: Array | None = None, y_warm: Array | None = None,
@@ -1039,10 +1087,7 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
     int_cols: int32 indices of integer columns (shared across batch).
     """
     int_cols = jnp.asarray(int_cols, jnp.int32)
-    S, n = qp.c.shape
     dt = qp.c.dtype
-    nI = int(int_cols.shape[0])
-    P = opts.pool_size
 
     sos1 = detect_sos1_groups(qp, d_col, int_cols)
     inc, x_inc, feas, warm = dive(qp, d_col, int_cols, opts,
@@ -1069,27 +1114,10 @@ def solve_mip(qp: BoxQP, d_col: Array, int_cols: Array,
         if verbose:
             _console.log(f"[bnb] swap-repaired incumbents: {np.asarray(inc)}")
 
-    lo0, hi0 = _root_bounds(qp, d_col, np.asarray(int_cols))
-    pool_lo = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
-        jnp.asarray(lo0, dt))
-    pool_hi = jnp.zeros((S, P, nI), dt).at[:, 0, :].set(
-        jnp.asarray(hi0, dt))
-    pool_bound = jnp.full((S, P), jnp.inf, dt).at[:, 0].set(-jnp.inf)
-    pool_active = jnp.zeros((S, P), bool).at[:, 0].set(True)
-
-    st = BnBState(
-        pool_lo=pool_lo, pool_hi=pool_hi, pool_bound=pool_bound,
-        pool_active=pool_active,
-        pool_depth=jnp.zeros((S, P), jnp.int32),
-        incumbent=jnp.where(feas, inc, jnp.inf).astype(dt),
-        x_inc=x_inc.astype(dt),
-        fathom_floor=jnp.full((S,), jnp.inf, dt),
-        lost_bound=jnp.full((S,), jnp.inf, dt),
-        x_warm=dive_x, y_warm=dive_y, omega_warm=omega, Lnorm=Lnorm,
-        outer=jnp.full((S,), -jnp.inf, dt),
-        done=jnp.zeros((S,), bool),
-        nodes_solved=jnp.zeros((S,), jnp.int32),
-    )
+    st = root_state(qp, d_col, int_cols, opts,
+                    incumbent=jnp.where(feas, inc, jnp.inf).astype(dt),
+                    x_inc=x_inc.astype(dt),
+                    warm=(dive_x, dive_y, omega, Lnorm))
     for r in range(opts.max_rounds):
         st = bnb_round(qp, d_col, int_cols, st, opts)
         if bool(np.all(np.asarray(st.done))):

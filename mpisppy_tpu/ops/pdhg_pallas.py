@@ -272,6 +272,48 @@ def _membership_padded(spec, m: int, m_p: int, dt):
     return (Mhead, Mhead.T, Mtail, Mtail.T)
 
 
+import dataclasses as _dc
+
+
+@_dc.dataclass(frozen=True, eq=False)
+class TileSynth:
+    """In-kernel tile synthesis (scengen, docs/scengen.md): instead of
+    DMA-ing a per-scenario data operand HBM->VMEM, the pipelined window
+    engine calls `fn(tile_index)` INSIDE the kernel and writes the
+    result straight into the VMEM working set — the DMA/compute overlap
+    machinery becomes synth/compute for those operands, and the (S, ·)
+    arrays never exist anywhere.
+
+    names: which data operands fn produces (subset of c/q/l/u/bl/bu).
+    fn:    trace-pure (tile_index, *const_values) ->
+           {name: (tile_s, padded_width)} KERNEL-READY values — already
+           scaled, padded, and bound-clipped exactly as run_window's
+           prep() would have produced for that tile slice
+           (scengen.tiles builds fn from a VirtualBatch and owns that
+           contract).
+    consts: arrays fn needs (base key, scalings, shared template rows)
+           — Pallas kernels cannot capture array constants, so these
+           ride as extra VMEM-resident kernel inputs and are handed to
+           fn as values.
+
+    Solver STATE (x/y/sums) and tau/sigma/done still stream via the
+    double-buffered DMA pipeline — they are genuine state, not
+    recomputable data.  eq=False keeps the object identity-hashable as
+    a jit static argument.
+
+    Portability: fn runs under the Pallas kernel compiler.  The XLA
+    interpret path (CPU tests) accepts any jnp/jax.random sampler;
+    Mosaic on real TPUs supports a narrower op set, so TPU deployments
+    should keep samplers to elementwise/integer ops (threefry's ARX
+    core lowers; exotic transcendentals may not) — the engine is
+    opt-in (`synth=`), never auto-selected.
+    """
+
+    names: tuple
+    fn: object
+    consts: tuple = ()
+
+
 def supported(p) -> bool:
     """Dense SHARED constraint matrix with a (S,)-batched problem.
     Conic problems (p.cones set) are supported: the kernel runs the SOC
@@ -283,12 +325,12 @@ def supported(p) -> bool:
 
 @partial(jax.jit,
          static_argnames=("n_iters", "tile_s", "precision", "pipeline",
-                          "interpret"))
+                          "interpret", "synth"))
 def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
                tau: Array, sigma: Array, done: Array,
                n_iters: int, tile_s: int = 128,
                precision: str | None = None, pipeline: bool = True,
-               interpret: bool = False):
+               interpret: bool = False, synth: "TileSynth | None" = None):
     """n_iters PDHG iterations over the whole scenario batch via the
     tiled Pallas kernel.  Returns (x, y, x_sum, y_sum).
 
@@ -298,6 +340,11 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
     hardware tiles; pad columns get l=u=0 (iterates pinned at 0), pad
     rows get free bounds (dual pinned at 0), pad scenarios are marked
     done — all three are exact no-ops on the real problem.
+
+    `synth` (pipeline mode only): a TileSynth generating the named data
+    operands in-kernel instead of streaming them — callers pass
+    (1, width) placeholders for those fields in `p` (their values are
+    never read), so nothing (S, ·)-shaped is materialized for them.
 
     `pipeline=True` (default) runs the DOUBLE-BUFFERED engine: one
     kernel invocation loops over scenario tiles, async-copying the next
@@ -387,11 +434,21 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
         cone_ops = (shift_p, socm, headm) \
             + _membership_padded(spec, m, m_p, dt)
 
+    if synth is not None:
+        if not pipeline:
+            raise ValueError("TileSynth requires the pipelined engine "
+                             "(pipeline=True)")
+        if has_cones:
+            raise ValueError("TileSynth does not support conic batches")
+        bad = set(synth.names) - {"c", "q", "l", "u", "bl", "bu"}
+        if bad:
+            raise ValueError(f"TileSynth cannot produce {sorted(bad)}")
+
     if pipeline:
         xo, yo, xso, yso = _run_window_pipelined(
             n_iters, prec, has_cones, tile_s, S_p, n_p, m_p, dt,
             mats, (tau_p, sigma_p, done_p, c, q, l, u, bl, bu),
-            cone_ops, (x_p, y_p, xs_p, ys_p), interpret)
+            cone_ops, (x_p, y_p, xs_p, ys_p), interpret, synth)
         return (xo[:S, :n], yo[:S, :m], xso[:S, :n], yso[:S, :m])
 
     grid = (S_p // tile_s,)
@@ -450,7 +507,8 @@ def run_window(p, x: Array, y: Array, x_sum: Array, y_sum: Array,
 
 
 def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
-                          dt, mats, params, cone_ops, state, interpret):
+                          dt, mats, params, cone_ops, state, interpret,
+                          synth=None):
     """The double-buffered window engine (ROADMAP item 2 / ISSUE 8).
 
     One kernel invocation owns the whole scenario batch: per-scenario
@@ -489,9 +547,13 @@ def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
     named += [("x", x_p, n_p), ("y", y_p, m_p),
               ("xs", xs_p, n_p), ("ys", ys_p, m_p)]
 
+    synth_names = () if synth is None else tuple(synth.names)
+    synth_consts = () if synth is None else tuple(synth.consts)
     dma_names, dma_arrs, dma_widths = [], [], []
     shared_names, shared_arrs = [], []
     for nm, arr, w in named:
+        if nm in synth_names:
+            continue  # generated in-kernel by synth.fn — no operand
         if arr.shape[0] == 1:      # shared across the batch: no DMA
             shared_names.append(nm)
             shared_arrs.append(arr)
@@ -511,6 +573,9 @@ def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
         cone_shared_vals = tuple(r[:] for r
                                  in refs[k:k + len(cone_shared)])
         k += len(cone_shared)
+        synth_const_vals = tuple(r[:] for r
+                                 in refs[k:k + len(synth_consts)])
+        k += len(synth_consts)
         in_refs = refs[k:k + n_in]
         k += n_in
         out_refs = refs[k:k + 4]
@@ -556,6 +621,12 @@ def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
             v = dict(shared_vals)
             for nm, scr in zip(dma_names, scr_in):
                 v[nm] = scr[cur]
+            if synth is not None:
+                # scengen: this tile's data operands are COMPUTED in
+                # the kernel (counter-based draws keyed by scenario
+                # index) — the synth/compute analog of the prefetch
+                # overlap; there is no HBM stream to hide for them
+                v.update(synth.fn(t, *synth_const_vals))
             cone_vals = ()
             if has_cones:
                 cone_vals = (v["shift"],) + cone_shared_vals
@@ -587,7 +658,8 @@ def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
 
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     hbm = pl.BlockSpec(memory_space=pltpu.ANY)
-    n_resident = len(mats) + len(shared_arrs) + len(cone_shared)
+    n_resident = len(mats) + len(shared_arrs) + len(cone_shared) \
+        + len(synth_consts)
     return pl.pallas_call(
         kernel,
         in_specs=[vmem] * n_resident + [hbm] * n_in,
@@ -599,4 +671,4 @@ def _run_window_pipelined(n_iters, prec, has_cones, tile_s, S_p, n_p, m_p,
             + [pltpu.SemaphoreType.DMA((2, n_in)),
                pltpu.SemaphoreType.DMA((2, 4))]),
         interpret=interpret,
-    )(*mats, *shared_arrs, *cone_shared, *dma_arrs)
+    )(*mats, *shared_arrs, *cone_shared, *synth_consts, *dma_arrs)

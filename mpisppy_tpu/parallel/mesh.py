@@ -108,9 +108,38 @@ def shard_batch(batch, mesh: Mesh):
     if S % mesh.size != 0:
         raise ValueError(
             f"{S} scenarios not divisible by mesh size {mesh.size}; "
-            "use core.batch.pad_to_multiple first")
+            "use core.batch.pad_to_multiple first"
+            + (" (scengen: virtual_batch(pad_to=mesh.size))"
+               if getattr(batch, "is_virtual", False) else ""))
     shard = scen_sharding(mesh)
     repl = replicated(mesh)
+
+    if getattr(batch, "is_virtual", False):
+        # scengen VirtualBatch (docs/scengen.md sharded synthesis):
+        # only the probabilities (and the multistage node map) carry
+        # the scenario axis — shard those, replicate the key + shared
+        # template.  Inside a jitted step, realize()'s fold_in/sampler
+        # chain partitions along the same axis via SPMD propagation, so
+        # each device synthesizes only its shard's scenarios from the
+        # same base key (the counter scheme makes the draws
+        # layout-invariant).
+        repl_tree = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, repl), batch.shared)
+        return dataclasses.replace(
+            batch,
+            base_key=jax.device_put(batch.base_key, repl),
+            p=jax.device_put(batch.p, shard),
+            d_col=jax.device_put(batch.d_col, repl),
+            d_row=jax.device_put(batch.d_row, repl),
+            d_non=jax.device_put(batch.d_non, repl),
+            nonant_idx=jax.device_put(batch.nonant_idx, repl),
+            node_of_slot=(None if batch.node_of_slot is None
+                          else jax.device_put(batch.node_of_slot,
+                                              shard)),
+            integer_slot=jax.device_put(batch.integer_slot, repl),
+            integer_full=jax.device_put(batch.integer_full, repl),
+            shared=repl_tree,
+        )
 
     def put(x, batched_ndim):
         if hasattr(x, "vals"):  # ops.sparse.EllMatrix: shard the values

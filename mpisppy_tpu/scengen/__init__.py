@@ -1,0 +1,25 @@
+###############################################################################
+# scengen: seeded on-device scenario synthesis (ROADMAP item 3a;
+# docs/scengen.md).
+#
+# Public surface:
+#   ScenarioProgram   declarative key -> scenario-data recipe
+#   scen_key          fold_in(base_key, scenario_index) — the counter scheme
+#   program_for       model-module bridge (models/{farmer,sslp,uc,aircond})
+#   virtual_batch     program -> VirtualBatch (O(n+m+S) resident pytree)
+#   materialize       program -> fully synthesized ScenarioBatch (device)
+#   to_specs          program -> host ScenarioSpec list (from_specs bridge)
+###############################################################################
+from mpisppy_tpu.scengen.program import (  # noqa: F401
+    FIELDS, ScenarioProgram, has_program, program_for, program_from_cfg,
+    sample_fields, scen_key,
+)
+from mpisppy_tpu.scengen.virtual import (  # noqa: F401
+    VirtualBatch, materialize, virtual_batch,
+)
+from mpisppy_tpu.scengen.tiles import window_inputs  # noqa: F401
+
+
+def to_specs(program):
+    """Host-materialize a program's sampled set as ScenarioSpecs."""
+    return program.to_specs()

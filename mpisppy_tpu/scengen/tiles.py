@@ -1,0 +1,113 @@
+###############################################################################
+# Kernel-tile synthesis: ScenarioProgram -> ops.pdhg_pallas.TileSynth.
+#
+# The Pallas double-buffered window engine streams each scenario tile's
+# operands HBM->VMEM while the previous tile computes.  For a program-
+# backed batch the data operands (c/q/l/u/bl/bu) need not exist in HBM
+# at all: this builder closes the program's sampler + template scaling
+# over the kernel and generates every tile's data IN the kernel — the
+# "synthesize tile t+1 into the VMEM slot instead of DMA-ing it" half
+# of ISSUE 14's tentpole.  Solver state (x/y/window sums, tau/sigma/
+# done) still rides the DMA pipeline: it is genuine state.
+#
+# The produced values are KERNEL-READY: scaled by the shared template
+# scaling (core.batch.scale_field — the same f32 arithmetic as realize
+# and from_specs(scaling=...)), padded to the hardware tile widths with
+# run_window's fill values, bound rows clipped to +-_BIG, and pad
+# scenarios clamped to the last real index — so a synth window
+# bit-matches a window over the materialized batch
+# (tests/test_scengen.py, interpret mode).
+###############################################################################
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.core.batch import scale_field
+from mpisppy_tpu.ops.boxqp import BoxQP
+from mpisppy_tpu.ops.pdhg_pallas import _BIG, _pad_last, _round_up, TileSynth
+from mpisppy_tpu.scengen.virtual import VirtualBatch
+
+_DATA_FIELDS = ("c", "q", "l", "u", "bl", "bu")
+_FILL = {"c": 0.0, "q": 0.0, "l": 0.0, "u": 0.0, "bl": -_BIG, "bu": _BIG}
+
+
+def window_inputs(vb: VirtualBatch, tile_s: int = 128):
+    """(qp_proxy, TileSynth) for ops.pdhg_pallas.run_window.
+
+    qp_proxy carries the REAL shared dense A (the kernel keeps it
+    VMEM-resident) and (1, width) placeholders for every data field —
+    their values are never read; the TileSynth generates all six data
+    operands per tile (varying fields sampled through the program's
+    counter-based keys, shared fields broadcast from the template), so
+    nothing (S, ·)-shaped exists for the data plane.
+    """
+    prog = vb.program
+    A = vb.shared.get("A")
+    if A is None or hasattr(A, "vals") or getattr(A, "ndim", 0) != 2:
+        raise ValueError(
+            "window_inputs needs a shared dense constraint matrix "
+            "(the Pallas window kernel's supported() shape); programs "
+            "varying A or using ELL keep the XLA synthesis path")
+    n = int(A.shape[1])
+    m = int(A.shape[0])
+    n_p = _round_up(n, 128)
+    m_p = _round_up(m, 128)
+    dt = prog.dtype
+    widths = {"c": n_p, "q": n_p, "l": n_p, "u": n_p,
+              "bl": m_p, "bu": m_p}
+
+    shared_pad = {}
+    for name in _DATA_FIELDS:
+        if name in prog.varying:
+            continue
+        val = vb.shared[name]
+        if name in ("bl", "bu"):
+            val = jnp.clip(val, -_BIG, _BIG)
+        shared_pad[name] = _pad_last(jnp.asarray(val, dt),
+                                     widths[name], _FILL[name])
+    base_key = vb.base_key
+    d_row, d_col = vb.d_row, vb.d_col
+    num_real, start = vb.num_real, prog.start
+    varying = prog.varying
+
+    def raw_fn(t):
+        from mpisppy_tpu.scengen.program import sample_fields
+        i = t * tile_s + jnp.arange(tile_s, dtype=jnp.int32)
+        idx = jnp.minimum(i, num_real - 1) + start
+        sampled = sample_fields(vb.program, idx, base_key=base_key)
+        out = []
+        for name in _DATA_FIELDS:
+            if name in varying:
+                val = scale_field(name, sampled[name], d_row, d_col)
+                if name in ("bl", "bu"):
+                    val = jnp.clip(val, -_BIG, _BIG)
+                out.append(_pad_last(val, widths[name], _FILL[name]))
+            else:
+                out.append(jnp.broadcast_to(
+                    shared_pad[name], (tile_s, widths[name])))
+        return out
+
+    # A Pallas kernel may not CAPTURE array constants (the base key,
+    # scalings, padded template rows, and whatever the model sampler
+    # itself closed over) — trace raw_fn once and hoist the jaxpr's
+    # constvars into an explicit argument list, which TileSynth.consts
+    # then passes as VMEM-resident kernel inputs
+    # (jax.closure_convert does NOT hoist concrete arrays — it folds
+    # them back in as jaxpr constants, re-creating the capture).
+    closed = jax.make_jaxpr(raw_fn)(jnp.asarray(0, jnp.int32))
+    consts = tuple(jnp.asarray(c) for c in closed.consts)
+
+    def fn(t, *const_vals):
+        vals = jax.core.eval_jaxpr(closed.jaxpr, const_vals, t)
+        return dict(zip(_DATA_FIELDS, vals))
+
+    def dummy(w):
+        return jnp.zeros((1, w), dt)
+
+    qp_proxy = BoxQP(
+        c=dummy(n), q=dummy(n), A=A,
+        bl=dummy(m), bu=dummy(m), l=dummy(n), u=dummy(n))
+    return qp_proxy, TileSynth(names=_DATA_FIELDS, fn=fn,
+                               consts=tuple(consts))

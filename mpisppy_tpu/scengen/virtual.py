@@ -1,0 +1,288 @@
+###############################################################################
+# VirtualBatch: a ScenarioBatch whose scenario data does not exist.
+#
+# The pytree holds only O(n + m + S) state — the base PRNG key, the
+# probabilities, the shared (pre-scaled) template fields, and the shared
+# Ruiz scalings — plus the ScenarioProgram as static metadata.
+# `realize()` synthesizes the full ScenarioBatch IN-TRACE: every jitted
+# iteration kernel concretizes a VirtualBatch at entry
+# (core.batch.concretize), so the (S, ...) scenario tensors exist only
+# as transients inside one device program and nothing scenario-sized is
+# ever built on the host or kept resident between steps.  That is what
+# decouples scenario count from memory (ROADMAP item 3a): at S = 1M the
+# persistent footprint is the solver state the algorithm inherently
+# carries, not the data.
+#
+# Sharded synthesis: parallel.mesh.shard_batch shards `p` (and the
+# multistage node map) over the scenario axis and replicates the key +
+# template.  Inside a jitted step XLA's SPMD partitioner then partitions
+# realize()'s iota/fold_in/sampler chain along the same axis — each
+# device folds in only its shard's scenario indices and generates only
+# its shard's data, while the counter-based key scheme guarantees the
+# draws are the ones any other layout would have produced
+# (__graft_entry__.dryrun_multichip holds the sharded case to this).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpisppy_tpu.core.batch import ScenarioBatch, scale_field
+from mpisppy_tpu.ops.boxqp import BoxQP
+from mpisppy_tpu.scengen.program import (
+    FIELDS, ScenarioProgram, estimate_materialized_bytes, sample_fields,
+)
+
+Array = jax.Array
+
+
+class _VirtualQP:
+    """Host-side shape/dtype view of the qp a VirtualBatch would
+    realize — enough surface for eager driver code (rho init reads
+    `batch.qp.c.dtype`, the bench flops model reads `A.shape`) without
+    synthesizing anything.  Inside kernels the batch is concretized
+    first, so traced code never sees this shim."""
+
+    def __init__(self, vb: "VirtualBatch"):
+        prog = vb.program
+        S = vb.num_scenarios
+        dt = prog.dtype
+        n = int(np.asarray(prog.template["c"]).shape[-1])
+        m = int(prog.template["A"].shape[0])
+        self.c = jax.ShapeDtypeStruct((S, n), dt)
+        self.q = jax.ShapeDtypeStruct((S, n), dt)
+        for f, width in (("l", n), ("u", n), ("bl", m), ("bu", m)):
+            shape = (S, width) if f in prog.varying else (width,)
+            setattr(self, f, jax.ShapeDtypeStruct(shape, dt))
+        self.A = vb.shared["A"] if "A" in vb.shared \
+            else jax.ShapeDtypeStruct((S, m, n), dt)
+        self.cones = None
+        self.n = n
+        self.m = m
+
+    @property
+    def batched(self) -> bool:
+        return True
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["base_key", "p", "d_col", "d_row", "d_non",
+                 "nonant_idx", "node_of_slot", "integer_slot",
+                 "integer_full", "shared"],
+    meta_fields=["program", "num_real"],
+)
+@dataclasses.dataclass(frozen=True)
+class VirtualBatch:
+    """The ScenarioBatch interface over synthesized scenarios.
+
+    shared: pre-scaled f32 template fields for every NON-varying qp
+    field (name -> array / EllMatrix), built once by virtual_batch().
+    node_of_slot is None for two-stage programs (synthesized as zeros
+    in realize()) and a stored (S, N) map for multistage trees.
+    """
+
+    base_key: Array
+    p: Array
+    d_col: Array
+    d_row: Array
+    d_non: Array
+    nonant_idx: Array
+    node_of_slot: Array | None
+    integer_slot: Array
+    integer_full: Array
+    shared: dict
+    program: ScenarioProgram
+    num_real: int
+
+    is_virtual = True
+
+    # -- ScenarioBatch surface (host-safe) --------------------------------
+    @property
+    def num_scenarios(self) -> int:
+        return int(self.p.shape[0])
+
+    @property
+    def num_nonants(self) -> int:
+        return int(self.nonant_idx.shape[0])
+
+    @property
+    def tree(self):
+        return self.program.tree
+
+    @property
+    def qp(self) -> _VirtualQP:
+        return _VirtualQP(self)
+
+    @property
+    def var_prob(self):
+        return None
+
+    def expectation(self, vals: Array) -> Array:
+        return jnp.sum(self.p * vals)
+
+    def nonants(self, x_scaled: Array) -> Array:
+        """Original-space nonants — d_non is SHARED by the template-
+        scaling contract, so this never synthesizes (the hub's
+        per-sync snapshot calls it eagerly)."""
+        return self.d_non * x_scaled[..., self.nonant_idx]
+
+    def nonant_box(self):
+        """Exact when the box is deterministic (every shipped program:
+        randomness lives in A or the row RHS, never in l/u)."""
+        prog = self.program
+        if "l" in prog.varying or "u" in prog.varying:
+            raise NotImplementedError(
+                "nonant_box over a program with a varying box would "
+                "need a tiled scan; no shipped program varies l/u")
+        nonant = np.asarray(self.nonant_idx)
+        d = np.asarray(self.d_non)
+        lb = np.asarray(self.shared["l"])[nonant] * d
+        ub = np.asarray(self.shared["u"])[nonant] * d
+        return lb, ub
+
+    # -- synthesis --------------------------------------------------------
+    def realize(self) -> ScenarioBatch:
+        """Synthesize the full ScenarioBatch (trace-pure — this is the
+        in-kernel materialization point).  Pad rows (p == 0) clone the
+        last real scenario's index, mirroring pad_to_multiple."""
+        prog = self.program
+        S = self.num_scenarios
+        i = jnp.arange(S, dtype=jnp.int32)
+        idx = jnp.minimum(i, self.num_real - 1) + prog.start
+        fields = sample_fields(prog, idx, base_key=self.base_key)
+
+        vals = {}
+        for name in FIELDS:
+            if name in prog.varying:
+                vals[name] = scale_field(name, fields[name],
+                                         self.d_row, self.d_col)
+            elif name in self.shared:
+                vals[name] = self.shared[name]
+        n = vals["c"].shape[-1]
+        qp = BoxQP(
+            c=jnp.broadcast_to(vals["c"], (S, n)),
+            q=jnp.broadcast_to(vals["q"], (S, n)),
+            A=vals["A"], bl=vals["bl"], bu=vals["bu"],
+            l=vals["l"], u=vals["u"],
+        )
+        if self.node_of_slot is not None:
+            nos = self.node_of_slot
+        else:
+            nos = jnp.zeros((S, self.num_nonants), jnp.int32)
+        return ScenarioBatch(
+            qp=qp, d_col=self.d_col, d_row=self.d_row, d_non=self.d_non,
+            p=self.p, nonant_idx=self.nonant_idx, node_of_slot=nos,
+            integer_slot=self.integer_slot,
+            integer_full=self.integer_full,
+            tree=prog.tree, num_real=self.num_real)
+
+    def persistent_bytes(self) -> int:
+        """Resident footprint of this pytree's DATA leaves — the
+        synthesized-path term of the bench's HBM high-water estimate."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+        return total
+
+    def materialized_bytes(self) -> int:
+        """What the host-materialized equivalent would keep resident."""
+        return estimate_materialized_bytes(self.program)
+
+
+def virtual_batch(program: ScenarioProgram, pad_to: int | None = None,
+                  bus=None) -> VirtualBatch:
+    """Build the VirtualBatch for a program (host; O(n + m + S) work).
+
+    pad_to: pad the scenario axis to a multiple (mesh divisibility) —
+    pad rows get probability 0 and clone the last real scenario, the
+    pad_to_multiple contract.  Emits one `scengen` telemetry event on
+    `bus` (when given) and mirrors the build into the metrics registry.
+    """
+    from mpisppy_tpu.core.batch import as_scaled_arrays
+
+    prog = program
+    S = prog.num_scenarios
+    S_p = S if pad_to is None else S + ((-S) % int(pad_to))
+    dt = prog.dtype
+
+    d_row_j, d_col_j = as_scaled_arrays(prog.scaling, dt)
+    shared = {}
+    for name in FIELDS:
+        if name in prog.varying:
+            continue
+        if name == "q":
+            tpl = prog.template.get("q")
+            if tpl is None:
+                tpl = np.zeros_like(np.asarray(prog.template["c"]))
+        else:
+            tpl = prog.template[name]
+        if name == "A":
+            import scipy.sparse as sps
+            if sps.issparse(tpl):
+                from mpisppy_tpu.ops import sparse as sparse_mod
+                tpl = sparse_mod.ell_from_scipy(tpl, dt)
+            else:
+                tpl = jnp.asarray(tpl, dt)
+        else:
+            tpl = jnp.asarray(tpl, dt)
+        shared[name] = scale_field(name, tpl, d_row_j, d_col_j)
+
+    probs = np.zeros(S_p, np.float64)
+    probs[:S] = 1.0 / S
+    nonant_idx = np.asarray(prog.nonant_idx, np.int32)
+    n = int(np.asarray(prog.template["c"]).shape[-1])
+    integer = prog.integer if prog.integer is not None \
+        else np.zeros(n, bool)
+
+    node_of_slot = None
+    if prog.tree.num_nodes > 1:
+        nos = prog.tree.node_of_slot()
+        if S_p > S:
+            nos = np.concatenate(
+                [nos, np.repeat(nos[-1:], S_p - S, axis=0)], axis=0)
+        node_of_slot = jnp.asarray(nos)
+
+    vb = VirtualBatch(
+        base_key=prog.base_key(),
+        p=jnp.asarray(probs, dt),
+        d_col=d_col_j, d_row=d_row_j,
+        d_non=d_col_j[nonant_idx],
+        nonant_idx=jnp.asarray(nonant_idx),
+        node_of_slot=node_of_slot,
+        integer_slot=jnp.asarray(integer[nonant_idx]),
+        integer_full=jnp.asarray(integer),
+        shared=shared,
+        program=prog,
+        num_real=S,
+    )
+
+    from mpisppy_tpu.telemetry import metrics as _metrics
+    saved = max(vb.materialized_bytes() - vb.persistent_bytes(), 0)
+    _metrics.REGISTRY.inc("scengen_virtual_batches_total")
+    _metrics.REGISTRY.set_gauge("scengen_scenarios", float(S))
+    _metrics.REGISTRY.set_gauge("scengen_data_bytes_saved", float(saved))
+    if bus is not None:
+        bus.emit("scengen", program=prog.name, num_scenarios=S,
+                 padded_to=S_p, base_seed=prog.base_seed,
+                 start=prog.start,
+                 persistent_bytes=vb.persistent_bytes(),
+                 materialized_bytes_est=vb.materialized_bytes())
+    return vb
+
+
+def materialize(program: ScenarioProgram) -> ScenarioBatch:
+    """Device-synthesize the WHOLE batch in one jitted realize — the
+    bit-identity counterpart of from_specs(program.to_specs(),
+    scaling=program.scaling) (tests/test_scengen.py holds every model
+    program to exact equality)."""
+    return _realize_jit(virtual_batch(program))
+
+
+@jax.jit
+def _realize_jit(vb: VirtualBatch) -> ScenarioBatch:
+    return vb.realize()

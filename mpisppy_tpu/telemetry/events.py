@@ -53,6 +53,11 @@ ADMISSION_REJECTED = "admission-rejected"  # serve layer: backpressure
                                        # refused a submit with a typed
                                        # reason (queue-full / quota /
                                        # draining) — never a hang
+SCENGEN = "scengen"                    # a VirtualBatch was built: the
+                                       # program, scenario count, base
+                                       # seed, and the resident-vs-
+                                       # materialized byte accounting
+                                       # (docs/scengen.md)
 KERNEL_COUNTERS = "kernel-counters"    # on-device counter harvest
 CONSOLE = "console"                    # a human-readable log line
 PROFILE = "profile"                    # profiler lifecycle: "start", or

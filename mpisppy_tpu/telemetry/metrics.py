@@ -66,6 +66,10 @@ ALL_METRICS = frozenset({
     # async wheel exchange plane (cylinders/hub.AsyncPHHub; ISSUE 11)
     "async_plane_writes_total",
     "async_plane_staleness",
+    # seeded scenario synthesis (mpisppy_tpu/scengen; docs/scengen.md)
+    "scengen_virtual_batches_total",
+    "scengen_scenarios",
+    "scengen_data_bytes_saved",
     # supervisors (resilience/watchdog.py)
     "watchdog_trips_total",
     # multi-tenant wheel server (mpisppy_tpu/serve; ISSUE 12)

@@ -108,6 +108,15 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # no-adversary baseline — the tenant-isolation line the serve_load
     # bench phase measures (docs/serving.md)
     (r"serve_load\.isolation\.isolation_ratio$", "up", 1.25),
+    # seeded scenario synthesis (ISSUE 14 acceptance; docs/scengen.md):
+    # recompute-instead-of-store must cost <= 10% PH throughput at the
+    # max common scale both paths hold resident...
+    (r"wheel_scengen\.synth_vs_materialized_ratio$", "down", 0.9),
+    # ...and the S=1M synthesized sweep entry must EXIST (bound 0 is a
+    # presence ratchet: any measured throughput meets it, but dropping
+    # the S=1M phase — the "as many scenarios as you can imagine"
+    # witness — fails as MISSING once an artifact has carried it)
+    (r"wheel_scengen\.sweep\.S1000000\.iters_per_sec$", "down", 0.0),
 )
 
 

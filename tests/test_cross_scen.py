@@ -99,7 +99,12 @@ def test_netdes_wheel_with_cross_scen_cuts():
     assert isinstance(b.qp.A, EllMatrix)
 
     cfg = Config()
-    cfg.quick_assign("max_iterations", int, 60)
+    # 35 iterations (was 60): every assertion below — cuts installed,
+    # valid outer, finite inner/gap, active Farkas rows — lands well
+    # inside 35 on this deterministic CPU run, and the classic-spoke
+    # wheel is the single most expensive tier-1 test (~275 s at 60
+    # iters vs ~153 s at 35; the suite must fit the tier-1 budget)
+    cfg.quick_assign("max_iterations", int, 35)
     cfg.quick_assign("default_rho", float, 300.0)
     cfg.quick_assign("rel_gap", float, 0.02)
     cfg.quick_assign("pdhg_tol", float, 1e-7)

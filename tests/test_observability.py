@@ -316,14 +316,17 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
 
     # a post-win artifact meets the floors in strict mode... (strict
     # requires EVERY milestone phase present, so the synthetic post-win
-    # artifact also carries the ISSUE-11 async-overhead phase and the
-    # ISSUE-12 serve isolation phase)
+    # artifact also carries the ISSUE-11 async-overhead phase, the
+    # ISSUE-12 serve isolation phase, and the ISSUE-14 scengen phase)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
     won["parsed"]["wheel_overhead_async"] = {"overhead_factor": 1.25}
     won["parsed"]["serve_load"] = {
         "isolation": {"isolation_ratio": 1.0}}
+    won["parsed"]["wheel_scengen"] = {
+        "synth_vs_materialized_ratio": 0.97,
+        "sweep": [{"scenarios": 1_000_000, "iters_per_sec": 0.07}]}
     won_path = tmp_path / "BENCH_won.json"
     won_path.write_text(json.dumps(won))
     rep2 = regress.gate_paths(r06, str(won_path), milestones=True)
