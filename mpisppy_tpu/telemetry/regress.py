@@ -73,6 +73,15 @@ GATES: tuple[tuple[str, str, float], ...] = (
     # ratio regressing is a serving regression (docs/serving.md)
     (r"serve_load\..*time_to_gap_p(50|99)_s$", "up", 0.25),
     (r"(^|\.)isolation_ratio$", "up", 0.25),
+    # IR-level kernel facts (ISSUE 15; KERNEL_IR.json, docs/
+    # static_analysis.md "IR layer"): bytes of concrete array
+    # constants baked into a kernel's jaxpr may NEVER grow (any growth
+    # is a new baked value — the per-value recompile-leak class), and
+    # the compiled temp-byte high-water per kernel ratchets at +10%
+    # (a materialized S-major temporary in a VirtualBatch-fed kernel
+    # multiplies it)
+    (r"kernels\..*\.const_bytes$", "up", 0.0),
+    (r"kernels\..*\.temp_bytes$", "up", 0.10),
 )
 
 #: absolute slack added on top of the relative threshold, so integer

@@ -36,10 +36,13 @@ def mini_repo(tmp_path, files: dict[str, str]):
 
 
 # ---------------------------------------------------------------------------
-# the tier-1 wiring: the repo itself lints clean on every pass
+# the tier-1 wiring: the repo itself lints clean on every AST pass.
+# The IR passes (tools/graftlint/ir/) need a fresh process for their
+# multi-device collective facts, so their clean-repo run lives in
+# tests/test_graftlint_ir.py as a subprocess CLI drive.
 # ---------------------------------------------------------------------------
 def test_repo_lints_clean():
-    rep = graftlint.lint(REPO)
+    rep = graftlint.lint(REPO, rules=[r.name for r in graftlint.AST_RULES])
     msgs = [f"{f['path']}:{f['line']} [{f['rule']}] {f['message']}"
             for f in rep["findings"] if not f["baselined"]]
     assert rep["errors"] == [] and msgs == [], "\n".join(msgs)
@@ -48,11 +51,15 @@ def test_repo_lints_clean():
 def test_required_empty_baseline_rules():
     """ISSUE 10 acceptance: lock-discipline / schema-drift /
     config-knob carry NO baseline entries (trace-purity and host-sync
-    may, with justification — currently none do)."""
+    may, with justification — currently none do).  ISSUE 15 extends
+    the ban to every IR pass: IR violations get fixed, not
+    grandfathered."""
     entries, errors = load_baseline(graftlint.DEFAULT_BASELINE)
     assert errors == []
     banned = {"lock-discipline", "schema-drift", "config-knob",
-              "no-print", "readme-claims"}
+              "no-print", "readme-claims",
+              "ir-const-capture", "ir-dtype-census", "ir-host-boundary",
+              "ir-collective-manifest", "ir-memory-high-water"}
     assert not [k for k in entries if k[0] in banned]
 
 
@@ -105,6 +112,78 @@ def test_trace_purity_private_method_inherits_via_jitted_sibling(tmp_path):
     """})
     names = {f.key.split("::")[1] for f in rules_trace_purity.run(ctx)}
     assert names == {"K._orphan"}, names
+
+
+def test_trace_purity_partial_wrapped_protection(tmp_path):
+    """`g = partial(jax.jit, ...)(f)` / `g = jax.jit(f, ...)` at module
+    level protect f exactly like a decorator — the ops/pdhg
+    `solve = jax.jit(_solve_impl, ...)` idiom must not be flagged
+    (ISSUE 15 satellite: the detector used to miss both forms)."""
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        def _impl(x):
+            return jax.lax.fori_loop(0, 3, lambda i, s: s + x, x)
+
+        solve = jax.jit(_impl, static_argnames=())
+
+        def _impl2(x):
+            return jax.lax.scan(lambda c, _: (c, c), x, None)
+
+        solve2 = partial(jax.jit, static_argnames=())(_impl2)
+
+        def leaky(x):
+            return jax.lax.while_loop(lambda s: s.any(),
+                                      lambda s: s - x, x)
+    """})
+    names = {f.key.split("::")[1] for f in rules_trace_purity.run(ctx)}
+    assert names == {"leaky"}, names
+
+
+def test_trace_purity_decorator_alias_protection(tmp_path):
+    """A module-level jit alias (`_jit = partial(jax.jit, ...)`) used
+    as a decorator protects the function it decorates (the second
+    missed form)."""
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+        from functools import partial
+
+        _jitted = partial(jax.jit, static_argnames=("n",))
+
+        @_jitted
+        def fine(x, n):
+            return jax.lax.fori_loop(0, n, lambda i, s: s + x, x)
+
+        def _helper(x):   # only reachable through the alias-wrapped g
+            return jax.lax.scan(lambda c, _: (c, c), x, None)
+
+        g = _jitted(_helper)
+
+        def leaky(x):
+            return jax.lax.cond(x.any(), lambda v: v, lambda v: -v, x)
+    """})
+    names = {f.key.split("::")[1] for f in rules_trace_purity.run(ctx)}
+    assert names == {"leaky"}, names
+
+
+def test_trace_purity_wrapped_fn_with_eager_caller_stays_flagged(tmp_path):
+    """The wrapping assignment counts as ONE protected caller, not a
+    blanket grant: a second, eager call path to the wrapped function
+    still bakes values into per-call jaxprs and must stay a finding."""
+    ctx = mini_repo(tmp_path, {"mpisppy_tpu/mod.py": """
+        import jax
+
+        def _impl(x):
+            return jax.lax.fori_loop(0, 3, lambda i, s: s + x, x)
+
+        solve = jax.jit(_impl)
+
+        def eager(x):          # reaches _impl OUTSIDE any jit
+            return _impl(x)
+    """})
+    names = {f.key.split("::")[1] for f in rules_trace_purity.run(ctx)}
+    assert names == {"_impl"}, names
 
 
 def test_trace_purity_catches_per_call_jit_wrapper(tmp_path):

@@ -4,8 +4,8 @@
 #
 #   python -m tools.graftlint [--json] [--rules a,b] [paths]
 #
-# Seven passes over mpisppy_tpu/ (see docs/static_analysis.md for the
-# rule catalog, suppression syntax and baseline workflow):
+# Seven AST passes over mpisppy_tpu/ (see docs/static_analysis.md for
+# the rule catalog, suppression syntax and baseline workflow):
 #
 #   trace-purity     eager lax control flow / per-call jit wrappers —
 #                    the PR-4 recompile-leak class, at lint time
@@ -17,6 +17,13 @@
 #   config-knob      undeclared cfg reads + dead declared knobs
 #   no-print         bare print( in library code
 #   readme-claims    README perf numbers vs committed BENCH artifacts
+#
+# ...plus the IR layer (tools/graftlint/ir/, ISSUE 15): five passes
+# over abstractly-lowered kernel jaxprs/HLO from the declarative
+# kernel manifest — ir-const-capture, ir-dtype-census,
+# ir-host-boundary, ir-collective-manifest, ir-memory-high-water —
+# with per-kernel facts committed as KERNEL_IR.json and ratcheted by
+# telemetry/regress.py GATES.
 #
 # When this package is imported with `tools` not on sys.path (the
 # legacy shims add tools/ itself), the absolute `tools.graftlint`
@@ -41,9 +48,10 @@ from tools.graftlint import (  # noqa: E402
     rules_no_print, rules_readme_claims, rules_schema_drift,
     rules_trace_purity,
 )
+from tools.graftlint import ir as _ir  # noqa: E402
 
 #: registration order = documentation order (docs/static_analysis.md)
-ALL_RULES = (
+AST_RULES = (
     rules_trace_purity.RULE,
     rules_lock_discipline.RULE,
     rules_host_sync.RULE,
@@ -52,6 +60,18 @@ ALL_RULES = (
     rules_no_print.RULE,
     rules_readme_claims.RULE,
 )
+
+#: the IR layer (tools/graftlint/ir/): abstract-lowering passes over
+#: the kernel manifest.  Part of the default rule set — `python -m
+#: tools.graftlint` lints source AND compiled-artifact structure — but
+#: kept addressable separately: the IR audit executes the kernels it
+#: judges (the one sanctioned exception to import-free linting) and
+#: wants a fresh process for multi-device facts, so in-process callers
+#: (the tier-1 AST clean test) select AST_RULES and the tier-1 IR test
+#: drives the CLI in a subprocess.
+IR_RULES = _ir.IR_RULES
+
+ALL_RULES = AST_RULES + IR_RULES
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")

@@ -2,6 +2,13 @@
 # graftlint CLI: `python -m tools.graftlint [--json] [paths]`.
 # Exit 0 = clean (baselined findings are reported but don't fail),
 # exit 1 = active findings or baseline errors (stale/unjustified).
+#
+# `--rules` with NO value lists every rule with its one-line doc — IR
+# rules additionally show how many manifest kernels they cover; with a
+# value it selects a comma-separated subset.  `--ir-cache DIR` (or
+# $GRAFTLINT_IR_CACHE) points the IR audit's jaxpr-hash lowering cache
+# somewhere CI and local runs can share; `--ir-subset fast` restricts
+# the audit to the tier-1 manifest subset.
 ###############################################################################
 from __future__ import annotations
 
@@ -9,6 +16,18 @@ import argparse
 import json
 import os
 import sys
+
+_LIST = "__list__"
+
+
+def _list_rules(graftlint) -> None:
+    from tools.graftlint.ir import kernel_counts
+    counts = kernel_counts()
+    for r in graftlint.ALL_RULES:
+        extra = ""
+        if r.name in counts:
+            extra = f"  [{counts[r.name]} kernels]"
+        print(f"{r.name:<24} {r.doc}{extra}")
 
 
 def main(argv=None) -> int:
@@ -21,25 +40,43 @@ def main(argv=None) -> int:
                     help="files/dirs to scan (default: mpisppy_tpu/)")
     ap.add_argument("--json", action="store_true",
                     help="machine report (schema graftlint-report/1)")
-    ap.add_argument("--rules",
-                    help="comma-separated subset of rule names")
+    ap.add_argument("--rules", nargs="?", const=_LIST, default=None,
+                    help="comma-separated subset of rule names; with "
+                         "no value, list all rules (IR rules with "
+                         "their kernel counts)")
     ap.add_argument("--baseline",
                     help="baseline file (default: the committed "
                          "tools/graftlint/baseline.json)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: the tree this tool "
                          "lives in)")
-    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--ir-cache", metavar="DIR",
+                    help="IR lowering cache dir (default: "
+                         "$GRAFTLINT_IR_CACHE)")
+    ap.add_argument("--ir-subset", choices=("full", "fast"),
+                    default="full",
+                    help="kernel-manifest subset the IR passes audit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="alias for bare --rules")
     ns = ap.parse_args(argv)
 
-    if ns.list_rules:
-        for r in graftlint.ALL_RULES:
-            print(f"{r.name:<16} {r.doc}")
+    if ns.list_rules or ns.rules == _LIST:
+        _list_rules(graftlint)
         return 0
+
+    if ns.ir_cache:
+        os.environ["GRAFTLINT_IR_CACHE"] = ns.ir_cache
+    rules = ns.rules.split(",") if ns.rules else None
+    if rules is None or any(r.startswith("ir-") for r in rules):
+        # multi-device facts need the virtual device count set before
+        # jax initializes — a no-op when jax is already up (the passes
+        # then degrade to unsharded facts)
+        from tools.graftlint.ir import audit, set_subset
+        audit.ensure_devices(2)
+        set_subset(ns.ir_subset)
 
     root = ns.root or os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    rules = ns.rules.split(",") if ns.rules else None
     rep = graftlint.lint(root, paths=ns.paths or None, rules=rules,
                          baseline_path=ns.baseline)
     if ns.json:

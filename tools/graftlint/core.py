@@ -70,6 +70,9 @@ class Context:
                  lib_dir: str = "mpisppy_tpu"):
         self.root = os.path.abspath(root)
         self.lib_dir = lib_dir
+        #: path-restricted scan (CLI positional args) — whole-repo
+        #: analyses (the IR audit) skip scoped scans
+        self.scoped = bool(paths)
         self._src: dict[str, str] = {}
         self._lines: dict[str, list[str]] = {}
         self._tree: dict[str, ast.AST] = {}

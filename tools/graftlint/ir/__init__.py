@@ -1,0 +1,20 @@
+###############################################################################
+# graftlint IR layer (ISSUE 15; docs/static_analysis.md "IR layer").
+#
+# A second analysis plane under the AST rules: every hot kernel in the
+# manifest (manifest.py) is abstractly lowered on small shapes and its
+# jaxpr/HLO facts are linted by five passes (passes.py) — const
+# capture, dtype census, host boundary, collective manifest, memory
+# high-water — with the per-kernel numbers committed as KERNEL_IR.json
+# and ratcheted by telemetry/regress.py GATES.
+#
+# Importing this package stays jax-free (manifest/passes import
+# lazily); the audit itself (audit.py) is the one sanctioned place the
+# lint executes the code it judges — abstract lowering IS the analysis.
+###############################################################################
+from __future__ import annotations
+
+from tools.graftlint.ir import manifest  # noqa: F401 (re-export)
+from tools.graftlint.ir.passes import (  # noqa: F401 (re-exports)
+    IR_RULES, kernel_counts, set_subset,
+)

@@ -1,0 +1,59 @@
+###############################################################################
+# KERNEL_IR.json emitter: `python -m tools.graftlint.ir --emit
+# KERNEL_IR.json [--subset fast|full] [--cache DIR]`.
+#
+# Runs the manifest audit and writes (or prints) the artifact the
+# regress gates ratchet: per-kernel const bytes (any-increase), temp
+# bytes (+10%), plus the dtype census / collective list / flop estimate
+# recorded for diffing.  Sets the virtual-CPU device count BEFORE jax
+# initializes so the sharded collective facts exist.
+###############################################################################
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint.ir",
+        description="IR-level kernel audit artifact emitter "
+                    "(docs/static_analysis.md, IR layer)")
+    ap.add_argument("--emit", help="write KERNEL_IR.json here "
+                                   "(default: print to stdout)")
+    ap.add_argument("--subset", choices=("full", "fast"),
+                    default="full")
+    ap.add_argument("--cache",
+                    help="lowering cache dir (default: "
+                         "$GRAFTLINT_IR_CACHE)")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="virtual CPU devices for sharded facts")
+    ns = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    if ns.cache:
+        os.environ["GRAFTLINT_IR_CACHE"] = ns.cache
+
+    from tools.graftlint.ir import audit
+    audit.ensure_devices(ns.devices)
+    facts = audit.run_manifest(root, subset=ns.subset)
+    artifact = audit.to_artifact(facts, subset=ns.subset)
+    text = json.dumps(artifact, indent=1, sort_keys=True)
+    if ns.emit:
+        with open(ns.emit, "w") as f:
+            f.write(text + "\n")
+        cached = sum(1 for f_ in facts.values() if f_.cached)
+        print(f"wrote {ns.emit}: {len(facts)} kernels "
+              f"({cached} lowering(s) from cache)")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

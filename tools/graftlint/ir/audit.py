@@ -1,0 +1,323 @@
+###############################################################################
+# graftlint IR layer: abstract lowering + fact extraction (ISSUE 15).
+#
+# For every manifest kernel this module derives one KernelFacts record
+# from two artifacts the AST can't see:
+#
+#   * the closed JAXPR (fn.trace(*args).jaxpr) — concrete array
+#     constants (the recompile-leak class), the dtype census over every
+#     equation variable (recursively through pjit/scan/while/cond
+#     sub-jaxprs), and host-callback primitives
+#     (pure_callback/io_callback/debug_callback);
+#   * the CPU-compiled executable — memory_analysis temp/arg/output
+#     high-water bytes, cost_analysis flop estimate, and (on a >= 2
+#     device mesh) the collective ops in the SPMD-partitioned HLO text.
+#
+# HLO facts ride behind a jaxpr-hash lowering cache (--ir-cache /
+# GRAFTLINT_IR_CACHE): the cache key is sha256 over (kernel name, jax
+# version, backend, device count, jaxpr pretty-print), so an unchanged
+# kernel costs one trace and zero compiles on re-runs — that is what
+# holds the tier-1 time budget.  Jaxpr-level facts are recomputed every
+# run (tracing is cheap; compiling is not).
+#
+# Device bring-up: collectives only exist in >= 2 device lowerings.
+# ensure_devices() forces the virtual-CPU device count via XLA_FLAGS
+# *before* jax initializes — callers that already initialized jax
+# single-device (an in-process pytest run) simply get no sharded facts
+# (facts.collectives is None, passes skip), which is why the tier-1 IR
+# tests drive the CLI in a subprocess.
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+
+from tools.graftlint.ir import manifest
+
+_COLLECTIVE_RE = re.compile("|".join(manifest.COLLECTIVE_KINDS))
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+_CACHE_ENV = "GRAFTLINT_IR_CACHE"
+
+
+@dataclasses.dataclass
+class KernelFacts:
+    """Everything the five IR passes judge, plus the KERNEL_IR.json
+    payload."""
+
+    name: str
+    path: str = ""                  # repo-relative source of the kernel
+    line: int = 1
+    const_bytes: int = 0            # total bytes of jaxpr array consts
+    consts: list = dataclasses.field(default_factory=list)
+    dtype_census: dict = dataclasses.field(default_factory=dict)
+    f64_count: int = 0
+    callbacks: list = dataclasses.field(default_factory=list)
+    collectives: list | None = None  # None = no sharded lowering ran
+    temp_bytes: int = 0
+    arg_bytes: int = 0
+    out_bytes: int = 0
+    flops: float = 0.0
+    cached: bool = False            # HLO facts served from the cache
+
+    def artifact_entry(self) -> dict:
+        """The KERNEL_IR.json per-kernel record (gate surface: the
+        regress GATES ratchet const_bytes any-increase and temp_bytes
+        +10%; the rest is recorded for diffing and the passes)."""
+        return {
+            "const_bytes": self.const_bytes,
+            "n_consts": len(self.consts),
+            "dtype_census": dict(sorted(self.dtype_census.items())),
+            "callbacks": list(self.callbacks),
+            "collectives": sorted(self.collectives or []),
+            "temp_bytes": self.temp_bytes,
+            "arg_bytes": self.arg_bytes,
+            "out_bytes": self.out_bytes,
+            "flops": self.flops,
+        }
+
+
+# ---------------------------------------------------------------------------
+# device bring-up
+# ---------------------------------------------------------------------------
+def ensure_devices(n: int = 2) -> None:
+    """Arrange for >= n virtual CPU devices.  Must run before jax
+    initializes; a no-op (callers degrade to unsharded facts) when jax
+    is already up."""
+    if "jax" in sys.modules:
+        return
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+def device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+def _sub_jaxprs(eqn):
+    for pv in eqn.params.values():
+        vals = pv if isinstance(pv, (list, tuple)) else (pv,)
+        for sub in vals:
+            if hasattr(sub, "jaxpr") and hasattr(sub, "consts"):
+                yield sub.jaxpr, list(sub.consts)     # ClosedJaxpr
+            elif hasattr(sub, "eqns"):
+                yield sub, []                         # raw Jaxpr
+
+
+def _walk_jaxpr(jaxpr, census: dict, callbacks: list, consts: list,
+                seen: set) -> None:
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _CALLBACK_PRIMS:
+            callbacks.append(eqn.primitive.name)
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None:
+                key = str(dt)
+                census[key] = census.get(key, 0) + 1
+        for sub, sub_consts in _sub_jaxprs(eqn):
+            consts.extend(sub_consts)
+            _walk_jaxpr(sub, census, callbacks, consts, seen)
+
+
+def _const_records(consts) -> tuple[int, list]:
+    """(total bytes, [{shape, dtype, nbytes}]) over array consts at or
+    above the manifest threshold; scalars and tiny index helpers are
+    idiomatic and skipped."""
+    total = 0
+    records = []
+    seen_ids = set()
+    for c in consts:
+        if id(c) in seen_ids:
+            continue
+        seen_ids.add(id(c))
+        nbytes = int(getattr(c, "nbytes", 0) or 0)
+        total += nbytes
+        if nbytes >= manifest.CONST_BYTES_THRESHOLD:
+            records.append({
+                "shape": list(getattr(c, "shape", ())),
+                "dtype": str(getattr(c, "dtype", "?")),
+                "nbytes": nbytes,
+            })
+    return total, records
+
+
+# ---------------------------------------------------------------------------
+# lowering cache
+# ---------------------------------------------------------------------------
+def cache_dir() -> str | None:
+    return os.environ.get(_CACHE_ENV) or None
+
+
+def _cache_key(name: str, jaxpr_text: str, devices: int) -> str:
+    import jax
+    h = hashlib.sha256()
+    for part in (name, jax.__version__, jax.default_backend(),
+                 str(devices), jaxpr_text):
+        h.update(part.encode())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def _cache_get(cdir: str | None, key: str) -> dict | None:
+    if not cdir:
+        return None
+    path = os.path.join(cdir, key + ".json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _cache_put(cdir: str | None, key: str, value: dict) -> None:
+    if not cdir:
+        return
+    try:
+        os.makedirs(cdir, exist_ok=True)
+        tmp = os.path.join(cdir, key + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, os.path.join(cdir, key + ".json"))
+    except OSError:
+        pass                    # cache is best-effort by design
+
+
+# ---------------------------------------------------------------------------
+# per-kernel audit
+# ---------------------------------------------------------------------------
+def _source_site(fn, root: str) -> tuple[str, int]:
+    """Repo-relative (path, line) of the kernel's def — the Finding
+    anchor (and where an inline `# graftlint: allow-ir-*` would go)."""
+    import inspect
+    target = fn
+    for attr in ("__wrapped__", "_fun", "func"):
+        inner = getattr(target, attr, None)
+        if inner is not None:
+            target = inner
+            break
+    try:
+        path = inspect.getsourcefile(target)
+        _, line = inspect.getsourcelines(target)
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+        return rel.replace(os.sep, "/"), line
+    except (TypeError, OSError):
+        return "tools/graftlint/ir/manifest.py", 1
+
+
+def _flops_of(cost) -> float:
+    entry = cost[0] if isinstance(cost, (list, tuple)) and cost else cost
+    if isinstance(entry, dict):
+        v = entry.get("flops")
+        if isinstance(v, (int, float)) and v >= 0:
+            return float(v)
+    return 0.0
+
+
+def audit_kernel(spec, fx, root: str, sharded_fx=None,
+                 cdir: str | None = None) -> KernelFacts:
+    """Build one kernel and derive its facts.  `fx` is the unsharded
+    Fixtures; `sharded_fx` (a mesh-carrying Fixtures, or None) feeds
+    the collective facts."""
+    fn, args = spec.build(fx)
+    facts = KernelFacts(name=spec.name)
+    facts.path, facts.line = _source_site(fn, root)
+
+    traced = fn.trace(*args)
+    closed = traced.jaxpr
+    census: dict = {}
+    callbacks: list = []
+    consts = list(closed.consts)
+    _walk_jaxpr(closed.jaxpr, census, callbacks, consts, set())
+    facts.dtype_census = census
+    facts.f64_count = sum(n for dt, n in census.items()
+                          if dt in ("float64", "complex128"))
+    facts.callbacks = sorted(set(callbacks))
+    facts.const_bytes, facts.consts = _const_records(consts)
+
+    jaxpr_text = str(closed)
+    key = _cache_key(spec.name, jaxpr_text, 1)
+    hlo_facts = _cache_get(cdir, key)
+    if hlo_facts is None:
+        compiled = traced.lower().compile()
+        mem = compiled.memory_analysis()
+        hlo_facts = {
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "arg_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+            "out_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "flops": _flops_of(compiled.cost_analysis()),
+        }
+        _cache_put(cdir, key, hlo_facts)
+    else:
+        facts.cached = True
+    facts.temp_bytes = hlo_facts["temp_bytes"]
+    facts.arg_bytes = hlo_facts["arg_bytes"]
+    facts.out_bytes = hlo_facts["out_bytes"]
+    facts.flops = hlo_facts["flops"]
+
+    if spec.sharded and sharded_fx is not None:
+        sfn, sargs = spec.build(sharded_fx)
+        straced = sfn.trace(*sargs)
+        skey = _cache_key(spec.name, str(straced.jaxpr),
+                          sharded_fx.mesh.devices.size)
+        cached = _cache_get(cdir, skey)
+        if cached is not None and "collectives" in cached:
+            facts.collectives = cached["collectives"]
+        else:
+            hlo = straced.lower().compile().as_text()
+            facts.collectives = sorted(set(_COLLECTIVE_RE.findall(hlo)))
+            _cache_put(cdir, skey, {"collectives": facts.collectives})
+    return facts
+
+
+def audit_kernels(specs, root: str, devices: int | None = None,
+                  cdir: str | None = None) -> dict[str, KernelFacts]:
+    """Audit `specs` (manifest KernelSpecs or compatible fixture specs)
+    sharing one Fixtures pair.  `devices=None` = shard when the running
+    backend has >= 2 devices."""
+    fx = manifest.Fixtures()
+    sharded_fx = None
+    want = device_count() if devices is None else devices
+    if want >= 2 and any(s.sharded for s in specs):
+        if device_count() >= 2:
+            from mpisppy_tpu.parallel import mesh as mesh_mod
+            sharded_fx = manifest.Fixtures(mesh=mesh_mod.make_mesh(2))
+    out = {}
+    for s in specs:
+        out[s.name] = audit_kernel(s, fx, root, sharded_fx=sharded_fx,
+                                   cdir=cdir)
+    return out
+
+
+def run_manifest(root: str, subset: str = "full",
+                 cdir: str | None = None) -> dict[str, KernelFacts]:
+    """The full audit entry point used by the IR passes and the
+    artifact emitter."""
+    ensure_devices(2)
+    specs = [s for s in manifest.MANIFEST
+             if subset == "full" or s.fast]
+    return audit_kernels(specs, root, cdir=cdir or cache_dir())
+
+
+def to_artifact(facts: dict[str, KernelFacts],
+                subset: str = "full") -> dict:
+    import jax
+    return {
+        "schema": "mpisppy-tpu-kernel-ir/1",
+        "jax": jax.__version__,
+        "subset": subset,
+        "kernels": {name: f.artifact_entry()
+                    for name, f in sorted(facts.items())},
+    }

@@ -1,0 +1,228 @@
+###############################################################################
+# graftlint IR layer: the five IR passes (ISSUE 15).
+#
+#   ir-const-capture       concrete array constants >= 1 KiB baked into
+#                          a kernel's jaxpr — the PR-4/PR-9 per-value
+#                          recompile-leak class caught structurally for
+#                          every manifest kernel, forever
+#   ir-dtype-census        f64 leaves/promotions inside kernels under
+#                          the docs/precision.md f32/bf16x3 contract
+#   ir-host-boundary       pure_callback/io_callback/debug_callback
+#                          primitives inside hot kernels — IR truth
+#                          replacing the lexical host-sync heuristic
+#   ir-collective-manifest sharded lowerings must contain EXACTLY their
+#                          declared collectives, both directions (the
+#                          per-kernel generalization of the dry run's
+#                          one-off HLO asserts)
+#   ir-memory-high-water   compiled temp-byte high-water; VirtualBatch-
+#                          fed kernels must stay under their declared
+#                          transients budget (the scengen "scenario
+#                          data exists only as transients" contract,
+#                          machine-checked)
+#
+# Each rule's `run(ctx)` audits the manifest once per scan (memoized on
+# the Context identity) and only against the repo this tools tree lives
+# in — a fixture mini-repo has no kernel manifest, so the IR rules are
+# structurally silent there and the seeded-violation tests drive the
+# per-rule `*_findings(spec, facts)` functions directly.
+###############################################################################
+from __future__ import annotations
+
+import os
+
+from tools.graftlint.core import Context, Finding, Rule
+from tools.graftlint.ir import manifest
+
+_HOME = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+#: audit subset the rules run ('full' | 'fast'); the CLI sets this
+#: (--ir-subset) before run_rules — tier-1 drives the fast subset
+SUBSET = "full"
+
+_MEMO: dict[tuple[int, str], tuple] = {}
+
+
+def set_subset(subset: str) -> None:
+    global SUBSET
+    if subset not in ("full", "fast"):
+        raise ValueError(f"ir subset must be 'full' or 'fast', "
+                         f"got {subset!r}")
+    SUBSET = subset
+
+
+def _audit_for(ctx: Context):
+    """(facts dict, error, state) for the scanned repo, or None when
+    the scan is not auditable: a root that is not the repo owning this
+    manifest (fixture trees), or a PATH-RESTRICTED scan — the IR audit
+    is a whole-manifest affair (kernels live all over the tree), so
+    `python -m tools.graftlint some/dir` stays an AST-only scan rather
+    than compiling 24 kernels and reporting findings outside the
+    requested paths."""
+    if os.path.abspath(ctx.root) != _HOME or getattr(ctx, "scoped", False):
+        return None
+    key = (id(ctx), SUBSET)
+    if key not in _MEMO:
+        try:
+            from tools.graftlint.ir import audit
+            facts = audit.run_manifest(ctx.root, subset=SUBSET)
+            _MEMO[key] = (facts, None, {})
+        except Exception as e:          # surfaced as a finding, once
+            _MEMO[key] = (None, f"{type(e).__name__}: {e}", {})
+    return _MEMO[key]
+
+
+# ---------------------------------------------------------------------------
+# per-rule finding functions (pure over (spec, facts) — the seeded
+# fixture tests call these directly)
+# ---------------------------------------------------------------------------
+def const_capture_findings(spec, facts) -> list[Finding]:
+    out = []
+    for i, rec in enumerate(facts.consts):
+        shape = "x".join(str(d) for d in rec["shape"]) or "scalar"
+        out.append(Finding(
+            "ir-const-capture", facts.path, facts.line,
+            f"kernel {spec.name}: concrete {rec['dtype']}[{shape}] "
+            f"constant ({rec['nbytes']} bytes) baked into the jaxpr — "
+            f"a closed-over array traces as a CONSTANT, so every "
+            f"distinct value recompiles (the PR-4 leak class); thread "
+            f"it through the kernel's arguments instead",
+            key=f"ir::{spec.name}::const::{rec['dtype']}[{shape}]#{i}"))
+    return out
+
+
+def dtype_census_findings(spec, facts) -> list[Finding]:
+    if not facts.f64_count:
+        return []
+    wide = {dt: n for dt, n in facts.dtype_census.items()
+            if dt in ("float64", "complex128")}
+    return [Finding(
+        "ir-dtype-census", facts.path, facts.line,
+        f"kernel {spec.name}: {facts.f64_count} f64 equation "
+        f"variable(s) in the traced IR ({wide}) — hot kernels hold the "
+        f"docs/precision.md f32/bf16x3 contract; keep f64 on the host "
+        f"side of the boundary",
+        key=f"ir::{spec.name}::f64")]
+
+
+def host_boundary_findings(spec, facts) -> list[Finding]:
+    return [Finding(
+        "ir-host-boundary", facts.path, facts.line,
+        f"kernel {spec.name}: {kind} primitive inside the traced "
+        f"kernel — a host round trip serializes every dispatch of a "
+        f"hot kernel; hoist it to the harvest/exchange boundary",
+        key=f"ir::{spec.name}::callback::{kind}")
+        for kind in facts.callbacks]
+
+
+def collective_manifest_findings(spec, facts) -> list[Finding]:
+    if not spec.sharded or facts.collectives is None:
+        return []
+    found = set(facts.collectives)
+    declared = set(spec.collectives)
+    out = []
+    for kind in sorted(declared - found):
+        out.append(Finding(
+            "ir-collective-manifest", facts.path, facts.line,
+            f"kernel {spec.name}: sharded lowering is MISSING declared "
+            f"collective {kind!r} — the kernel no longer communicates "
+            f"where the manifest says it must (a silently-local "
+            f"reduction computes the wrong answer per shard)",
+            key=f"ir::{spec.name}::collective-missing::{kind}"))
+    for kind in sorted(found - declared):
+        out.append(Finding(
+            "ir-collective-manifest", facts.path, facts.line,
+            f"kernel {spec.name}: sharded lowering contains UNDECLARED "
+            f"collective {kind!r} — declare it in the manifest "
+            f"(tools/graftlint/ir/manifest.py) or remove the "
+            f"communication",
+            key=f"ir::{spec.name}::collective-extra::{kind}"))
+    return out
+
+
+def memory_high_water_findings(spec, facts) -> list[Finding]:
+    if not spec.virtual or spec.temp_budget_bytes is None:
+        return []
+    if facts.temp_bytes <= spec.temp_budget_bytes:
+        return []
+    return [Finding(
+        "ir-memory-high-water", facts.path, facts.line,
+        f"kernel {spec.name}: compiled temp high-water "
+        f"{facts.temp_bytes} bytes exceeds the VirtualBatch transients "
+        f"budget {spec.temp_budget_bytes} — an S-major tensor is being "
+        f"materialized beyond the realize() transient "
+        f"(docs/scengen.md: scenario data exists only as transients)",
+        key=f"ir::{spec.name}::temp-high-water")]
+
+
+_FINDERS = {
+    "ir-const-capture": const_capture_findings,
+    "ir-dtype-census": dtype_census_findings,
+    "ir-host-boundary": host_boundary_findings,
+    "ir-collective-manifest": collective_manifest_findings,
+    "ir-memory-high-water": memory_high_water_findings,
+}
+
+
+def _make_run(rule_name: str):
+    def run(ctx: Context) -> list[Finding]:
+        res = _audit_for(ctx)
+        if res is None:
+            return []
+        facts, err, state = res
+        if err is not None:
+            # a broken audit must never read as a clean repo: whichever
+            # SELECTED ir-* rule runs first reports it (exactly once
+            # per scan, whatever the rule subset)
+            if state.get("err_reported"):
+                return []
+            state["err_reported"] = True
+            return [Finding(
+                rule_name, "tools/graftlint/ir/audit.py", 1,
+                f"IR audit failed to run: {err}",
+                key="ir-audit-failed")]
+        finder = _FINDERS[rule_name]
+        out = []
+        for name, f in sorted(facts.items()):
+            out.extend(finder(manifest.spec(name), f))
+        return out
+    return run
+
+
+def kernel_counts() -> dict[str, int]:
+    """rule name -> number of manifest kernels the pass covers (the
+    --rules listing; importing this never touches jax)."""
+    all_n = len(manifest.MANIFEST)
+    return {
+        "ir-const-capture": all_n,
+        "ir-dtype-census": all_n,
+        "ir-host-boundary": all_n,
+        "ir-collective-manifest":
+            sum(1 for s in manifest.MANIFEST if s.sharded),
+        "ir-memory-high-water":
+            sum(1 for s in manifest.MANIFEST if s.virtual),
+    }
+
+
+IR_RULES = (
+    Rule("ir-const-capture",
+         "concrete array constants baked into kernel jaxprs "
+         "(per-value recompile leak, IR-level)",
+         _make_run("ir-const-capture")),
+    Rule("ir-dtype-census",
+         "f64 leaves/promotions inside kernels under the f32/bf16x3 "
+         "precision contract",
+         _make_run("ir-dtype-census")),
+    Rule("ir-host-boundary",
+         "host callback primitives inside hot kernels (IR truth for "
+         "the host boundary)",
+         _make_run("ir-host-boundary")),
+    Rule("ir-collective-manifest",
+         "sharded lowerings contain exactly their declared "
+         "collectives, both directions",
+         _make_run("ir-collective-manifest")),
+    Rule("ir-memory-high-water",
+         "VirtualBatch-fed kernels stay under their compiled "
+         "temp-bytes transients budget",
+         _make_run("ir-memory-high-water")),
+)

@@ -20,10 +20,10 @@
 #   4. GATE KEYS — every GATES/MILESTONES pattern in
 #      telemetry/regress.py must match at least one metric key
 #      produced by a COMMITTED artifact: the BENCH_r0*/BENCH_DETAIL/
-#      DEVICE_PROFILE/SSLP_CERT JSON files plus analyzer reports
-#      derived from the committed tests/fixtures/golden_*.jsonl
-#      traces.  A gate nothing can produce is dead armor — it looks
-#      like protection and gates nothing.
+#      DEVICE_PROFILE/SSLP_CERT/KERNEL_IR JSON files plus analyzer
+#      reports derived from the committed tests/fixtures/
+#      golden_*.jsonl traces.  A gate nothing can produce is dead
+#      armor — it looks like protection and gates nothing.
 #
 # Events/metrics declarations are read by AST (no import of the
 # package under scan); the gate-key check loads telemetry/regress.py
@@ -207,7 +207,8 @@ def _load_by_path(ctx: Context, rel: str, name: str):
 def committed_key_pool(ctx: Context, regress) -> set[str]:
     pool: set[str] = set()
     for pat in ("BENCH_r0*.json", "BENCH_DETAIL.json",
-                "DEVICE_PROFILE.json", "SSLP_CERT.json"):
+                "DEVICE_PROFILE.json", "SSLP_CERT.json",
+                "KERNEL_IR.json"):
         for p in sorted(glob.glob(os.path.join(ctx.root, pat))):
             try:
                 pool |= set(regress.extract_metrics(
@@ -328,9 +329,9 @@ def run(ctx: Context) -> list[Finding]:
                         RULE_NAME, reg_rel, line,
                         f"{table} pattern {pat!r} matches no metric "
                         f"key of any committed artifact (BENCH_*/"
-                        f"DEVICE_PROFILE/SSLP_CERT/golden-trace "
-                        f"analyzer report) — a gate nothing produces "
-                        f"gates nothing",
+                        f"DEVICE_PROFILE/SSLP_CERT/KERNEL_IR/"
+                        f"golden-trace analyzer report) — a gate "
+                        f"nothing produces gates nothing",
                         key=f"gate-unresolved::{pat}"))
     return out
 

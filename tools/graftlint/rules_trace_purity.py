@@ -13,7 +13,14 @@
 #   * a function is JIT-PROTECTED when it is decorated with jax.jit /
 #     partial(jax.jit, ...) / pl.pallas_call-style kernels, when its
 #     name contains "_jit" (the repo convention for trace-only
-#     helpers), or when it is nested inside a protected function;
+#     helpers), when it is nested inside a protected function, when a
+#     MODULE-LEVEL assignment wraps it (`g = jax.jit(f)` /
+#     `g = partial(jax.jit, ...)(f)` — the wrapper counts as one
+#     protected CALLER in the fixed point, so an additional eager call
+#     path to f still flags), or when it is decorated with a
+#     module-level jit ALIAS (`_jit = partial(jax.jit,
+#     static_argnames=...)` then `@_jit` — the decorator-aliased
+#     form);
 #   * a PRIVATE top-level function (leading underscore) inherits
 #     protection when every intra-module caller is protected (fixed
 #     point over the module call graph) — e.g. simplex_qp._estimate_L
@@ -43,9 +50,62 @@ CONTROL_FLOW = {"fori_loop", "while_loop", "scan", "cond", "switch"}
 _JIT_DEC_RE = re.compile(r"(^|[.(\s])jit\b")
 
 
-def _dec_is_jit(dec: ast.expr) -> bool:
-    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit)."""
-    return bool(_JIT_DEC_RE.search(ast.unparse(dec)))
+def _dec_is_jit(dec: ast.expr, aliases: frozenset = frozenset()) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / functools.partial(jit),
+    or a module-level alias of one of those (`@_jit`, `@_jit(...)`)."""
+    if _JIT_DEC_RE.search(ast.unparse(dec)):
+        return True
+    if isinstance(dec, ast.Name) and dec.id in aliases:
+        return True
+    if isinstance(dec, ast.Call) and isinstance(dec.func, ast.Name) \
+            and dec.func.id in aliases:
+        return True
+    return False
+
+
+def _jit_aliases(tree: ast.Module) -> frozenset:
+    """Module-level names bound to a jit DECORATOR FACTORY:
+    `_jit = jax.jit` or `_jit = partial(jax.jit, static_argnames=...)`
+    (the value mentions jit but does not yet APPLY it to a function —
+    that's the wrapped-assignment case below)."""
+    out = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        val = node.value
+        if isinstance(val, (ast.Attribute, ast.Name)) \
+                and _JIT_DEC_RE.search(ast.unparse(val)):
+            out.add(node.targets[0].id)
+        elif isinstance(val, ast.Call) \
+                and ast.unparse(val.func).split(".")[-1] == "partial" \
+                and any(_JIT_DEC_RE.search(ast.unparse(a))
+                        for a in val.args):
+            out.add(node.targets[0].id)
+    return frozenset(out)
+
+
+def _wrapped_protected(tree: ast.Module, aliases: frozenset) -> set:
+    """Function names WRAPPED by a module-level jit assignment:
+    `g = jax.jit(f, ...)`, `g = partial(jax.jit, ...)(f)`,
+    `g = _jit(f)`.  A wrapped name is NOT unconditionally protected —
+    the wrapper counts as one protected CALLER in the fixed point, so
+    f still gets flagged when some other intra-module caller reaches
+    it eagerly (a direct f() call outside any jit is exactly the PR-4
+    leak the wrapping was supposed to prevent)."""
+    out: set[str] = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        func_txt = ast.unparse(call.func)
+        is_jit = bool(_JIT_DEC_RE.search(func_txt)) \
+            or (isinstance(call.func, ast.Name)
+                and call.func.id in aliases)
+        if is_jit and call.args and isinstance(call.args[0], ast.Name):
+            out.add(call.args[0].id)
+    return out
 
 
 def _is_lax_cf(call: ast.Call) -> str | None:
@@ -76,13 +136,16 @@ def _analyze_module(tree: ast.Module):
     """Top-level function table + module-level control-flow sites."""
     fns: dict[str, _FnInfo] = {}
     module_sites: list[tuple[int, str]] = []
+    aliases = _jit_aliases(tree)
+    wrapped = _wrapped_protected(tree, aliases)
 
     def scan_body(fn: _FnInfo | None, node: ast.AST,
                   protected: bool) -> None:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 child_protected = protected \
-                    or any(_dec_is_jit(d) for d in child.decorator_list) \
+                    or any(_dec_is_jit(d, aliases)
+                           for d in child.decorator_list) \
                     or "_jit" in child.name
                 scan_body(fn, child, child_protected)
                 continue
@@ -113,7 +176,7 @@ def _analyze_module(tree: ast.Module):
     for node in tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             info = _FnInfo(node.name, node)
-            info.protected = any(_dec_is_jit(d)
+            info.protected = any(_dec_is_jit(d, aliases)
                                  for d in node.decorator_list) \
                 or "_jit" in node.name
             fns[node.name] = info
@@ -126,7 +189,7 @@ def _analyze_module(tree: ast.Module):
                 if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     info = _FnInfo(f"{node.name}.{b.name}", b,
                                    cls=node.name)
-                    info.protected = any(_dec_is_jit(d)
+                    info.protected = any(_dec_is_jit(d, aliases)
                                          for d in b.decorator_list) \
                         or "_jit" in b.name
                     fns[info.name] = info
@@ -143,23 +206,34 @@ def _analyze_module(tree: ast.Module):
                     if kind is not None:
                         module_sites.append((sub.lineno, kind))
 
-    # fixed point: a private function whose every intra-module caller
-    # is protected inherits protection
+    # fixed point: a private (or module-level jit-WRAPPED) function
+    # whose every intra-module caller is protected inherits protection.
+    # The wrapping assignment itself counts as one protected caller —
+    # so `g = jax.jit(f)` protects f, but a second, eager f() call
+    # site keeps it flagged.
     callers: dict[str, set[str]] = {n: set() for n in fns}
     for name, info in fns.items():
         for callee in info.calls:
             if callee in fns:
                 callers[callee].add(name)
+    _WRAP = "<module-jit-wrap>"
+    wrap_info = _FnInfo(_WRAP, None)
+    wrap_info.protected = True
+    fns[_WRAP] = wrap_info
+    for name in wrapped:
+        if name in callers:
+            callers[name].add(_WRAP)
     changed = True
     while changed:
         changed = False
         for name, info in fns.items():
-            if info.protected or not info.private:
+            if info.protected or not (info.private or name in wrapped):
                 continue
-            cs = callers[name] - {name}
+            cs = callers.get(name, set()) - {name}
             if cs and all(fns[c].protected for c in cs):
                 info.protected = True
                 changed = True
+    del fns[_WRAP]
     return fns, module_sites
 
 
