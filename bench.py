@@ -1155,6 +1155,159 @@ def bench_serve_load():
     }
 
 
+def bench_fleet_serve_load():
+    """ISSUE 16 acceptance: the replicated serve fleet under load with
+    a replica killed mid-traffic (docs/serving.md fleet section).  A
+    3-replica FleetRouter — each replica a full wheel server with its
+    own engine and structure interner over one shared checkpoint spool
+    — serves the mixed farmer/sslp/uc workload twice: a fault-free
+    round (p50/p99 client-observed time-to-gap) and a chaos round
+    where r0 dies mid-traffic and its running sessions LIVE-MIGRATE
+    (emergency checkpoint -> requeue -> restore on a surviving
+    replica).  Gates: the latency keys at +-25% and isolation_ratio
+    (chaos p99 over fault-free p99) ride the serve_load patterns;
+    fleet_migrations_lost_total carries an any-increase gate and
+    migrated_reached_gap_frac a 1.0 ratchet MILESTONE
+    (telemetry/regress.py)."""
+    import json as _json
+    import tempfile
+
+    from mpisppy_tpu.fleet import FleetOptions, FleetRouter
+    from mpisppy_tpu.resilience.faults import FaultPlan, ReplicaFault
+    from mpisppy_tpu.serve import loadgen
+    from mpisppy_tpu.telemetry import metrics as _metrics
+
+    n_replicas = 3
+    n_clients = 4 if SMOKE else 8
+    sessions_each = 1 if SMOKE else 2
+    tenants = ("acme", "zeta")
+    deadline_s = 600.0
+    heartbeat_s = 0.5
+
+    def run_round(fault_plan=None):
+        td = tempfile.mkdtemp(prefix="fleet_load_")
+        router = FleetRouter(FleetOptions(
+            unix_path=os.path.join(td, "fleet.sock"),
+            n_replicas=n_replicas, max_running_per_replica=1,
+            max_queued=24, max_queued_per_tenant=8, tenant_quota=2,
+            trace_dir=os.path.join(td, "traces"),
+            spool_dir=os.path.join(td, "spool"),
+            heartbeat_s=heartbeat_s, drain_grace_s=60.0,
+            fault_plan=fault_plan)).start()
+        try:
+            recs = loadgen.run_load(
+                router.address, n_clients=n_clients,
+                sessions_each=sessions_each, tenants=tenants,
+                mix=loadgen.DEFAULT_MIX, gap_target=GAP_TARGET,
+                max_iterations=300, deadline_s=deadline_s,
+                fault_plan=fault_plan)
+            stats = router.stats()
+        finally:
+            router.stop()
+        # evidence scan: terminal session-state transitions and
+        # migrations in the router stream (one file, every replica)
+        terminals: dict = {}
+        migrated: set = set()
+        fleet_log = os.path.join(td, "traces", "fleet.jsonl")
+        if os.path.exists(fleet_log):
+            with open(fleet_log) as f:
+                for line in f:
+                    try:
+                        row = _json.loads(line)
+                    except ValueError:
+                        continue
+                    d = row.get("data", {})
+                    if row.get("kind") == "session-state" \
+                            and d.get("state") in ("DONE", "FAILED",
+                                                   "REJECTED"):
+                        sid = d.get("session")
+                        terminals[sid] = terminals.get(sid, 0) + 1
+                    elif row.get("kind") == "session-migrated" \
+                            and not d.get("queued"):
+                        migrated.add(d.get("session"))
+        return recs, stats, terminals, migrated
+
+    t0 = time.perf_counter()
+    lost0 = _metrics.REGISTRY.get("fleet_migrations_lost_total")
+    # warm-up round (uncounted): every model in the mix compiles once
+    # per process, so the A/B below compares serving, not jit
+    run_round()
+    base_recs, base_stats, base_terms, _ = run_round()
+    base = loadgen.summarize(base_recs, healthy_tenants=tenants)
+
+    # chaos round: r0 stops heartbeating a few beats in — the router
+    # fences it, drains it, and its sessions migrate mid-solve
+    kill_beat = 2 if SMOKE else 8
+    plan = FaultPlan(seed=12, replicas=(
+        ReplicaFault("kill", replica="r0", at_beats=(kill_beat,)),))
+    chaos_recs, chaos_stats, chaos_terms, migrated = run_round(plan)
+    chaos = loadgen.summarize(chaos_recs, healthy_tenants=tenants)
+
+    mig_recs = [r for r in chaos_recs if r.get("session") in migrated]
+    mig_hit = sum(1 for r in mig_recs
+                  if r["time_to_gap_s"] is not None)
+    mig_frac = round(mig_hit / len(mig_recs), 4) if mig_recs else None
+    ratio = None
+    if base["time_to_gap_p99_s"] and chaos["time_to_gap_p99_s"]:
+        ratio = round(chaos["time_to_gap_p99_s"]
+                      / base["time_to_gap_p99_s"], 4)
+    multi = {sid: n for sid, n in {**base_terms, **chaos_terms}.items()
+             if n > 1}
+    lost = _metrics.REGISTRY.get("fleet_migrations_lost_total") - lost0
+    return {
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "sessions": base["sessions"],
+        "iter_precision": ITER_PRECISION or "bf16x6",
+        "gap_target": GAP_TARGET,
+        "reached_gap": base["reached_gap"],
+        "time_to_gap_p50_s": base["time_to_gap_p50_s"],
+        "time_to_gap_p99_s": base["time_to_gap_p99_s"],
+        "outcomes": base["outcomes"],
+        "placement": {
+            # process-cumulative across the three rounds
+            "affinity": _metrics.REGISTRY.get(
+                "fleet_placement_affinity_total"),
+            "spill": _metrics.REGISTRY.get(
+                "fleet_placement_spill_total"),
+        },
+        "isolation": {
+            "chaos": "kill r0 mid-traffic",
+            "baseline_p99_s": base["time_to_gap_p99_s"],
+            "chaos_p50_s": chaos["time_to_gap_p50_s"],
+            "chaos_p99_s": chaos["time_to_gap_p99_s"],
+            "chaos_reached_gap": chaos["reached_gap"],
+            "chaos_outcomes": chaos["outcomes"],
+            "isolation_ratio": ratio,
+        },
+        "migration": {
+            "replica_deaths": 1,
+            "migrated_sessions": len(migrated),
+            "migration_counters": chaos_stats["migration"],
+            "migrated_reached_gap_frac": mig_frac,
+            "fleet_migrations_lost_total": lost,
+            "sessions_multi_terminal": len(multi),
+        },
+        "single_replica_ref": {
+            # BENCH_r09 serve_load on the same workload shape (one
+            # 3-slot server vs this 3x1-slot fleet)
+            "time_to_gap_p50_s": 1.9227,
+            "time_to_gap_p99_s": 5.9392,
+            "isolation_ratio": 0.9732,
+        },
+        "bench_fleet_total_sec": round(time.perf_counter() - t0, 1),
+        "note": "replicated serve fleet under load: 3 replicas x 1 "
+                "slot, each a full wheel server with its own engine/"
+                "interner over one shared checkpoint spool; fault-free "
+                "round vs chaos round with r0 killed mid-traffic; "
+                "running sessions on r0 live-migrate (emergency "
+                "checkpoint -> requeue -> restore elsewhere); "
+                "isolation_ratio = chaos p99 / fault-free p99; every "
+                "session must observe exactly one terminal outcome "
+                "and fleet_migrations_lost_total must stay 0",
+    }
+
+
 _PHASES = {
     "sslp_to_1pct_gap": lambda: bench_sslp_gap(),
     "uc_fwph_to_1pct_gap": lambda: bench_uc_fwph(),
@@ -1165,6 +1318,7 @@ _PHASES = {
     "measured_mfu": lambda: bench_measured_mfu(),
     "wheel_scengen": lambda: bench_wheel_scengen(),
     "serve_load": lambda: bench_serve_load(),
+    "fleet_serve_load": lambda: bench_fleet_serve_load(),
     "baseline_anchor": lambda: bench_baseline_anchor(),
 }
 

@@ -520,6 +520,16 @@ class PHHub(Hub):
         if self.options.get("run_id"):
             _dispatch.set_session_context(self.run_id, self._iter)
         _dispatch.set_hub_iter(self._iter)
+        # live-migration drain (ISSUE 16): the fleet router sets the
+        # session's preempt_event to move this wheel; raising here
+        # lands the emergency checkpoint at a consistent sync boundary
+        # (WheelSpinner.spin's preemption path), after which the
+        # session restores on another replica via load_checkpoint
+        drain = self.options.get("preempt_event")
+        if drain is not None and drain.is_set():
+            from mpisppy_tpu.resilience.faults import PreemptionError
+            raise PreemptionError(
+                f"migration drain requested at iter {self._iter}")
         plan = self.options.get("fault_plan")
         if plan is not None:
             plan.telemetry_iter = self._iter

@@ -224,6 +224,49 @@ class ServeFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class ReplicaFault:
+    """One fleet-replica fault (ISSUE 16; docs/serving.md): the
+    host-only seams of the fleet router's health plane
+    (mpisppy_tpu/fleet/).
+
+    kind: 'kill'           -> the replica dies at its at_beats[0]-th
+                              heartbeat: the beat loop stops (the
+                              router declares it dead after the miss
+                              budget) and no new work is assigned;
+                              in-flight sessions drain through the
+                              SIGTERM-grace emergency-checkpoint path
+                              and migrate to live replicas
+          'partition'      -> heartbeats AND router status probes are
+                              suppressed while the beat index is
+                              inside the at_beats window; a window
+                              longer than the miss budget migrates the
+                              replica's sessions, and the replica
+                              stays FENCED (dead to the router) even
+                              after connectivity returns — no split
+                              brain, the settle latch still guarantees
+                              one terminal outcome if a partitioned
+                              worker races a migrated copy
+          'slow_heartbeat' -> every beat is delayed delay_s extra
+                              (clock skew / an overloaded host; at
+                              worst the replica turns SUSPECT, never
+                              loses a session)
+
+    replica: which replica id the fault fires on ("" = every
+    replica).  at_beats: 0-based beat indices — the kill beat for
+    'kill' (empty = beat 0), the suppressed window for 'partition'
+    (empty = never)."""
+
+    kind: str
+    replica: str = ""
+    at_beats: tuple[int, ...] = ()
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in ("kill", "partition", "slow_heartbeat"):
+            raise ValueError(f"unknown replica fault {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointFault:
     """Damage the `at_write`-th completed checkpoint file (0-based).
 
@@ -251,7 +294,7 @@ class FaultPlan:
 
     def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
                  checkpoints=(), preempt_at_iter: int | None = None,
-                 dispatches=(), exchanges=(), serves=()):
+                 dispatches=(), exchanges=(), serves=(), replicas=()):
         self.rng = np.random.default_rng(seed)
         self.spoke_bounds = tuple(spoke_bounds)
         self.lanes = tuple(lanes)
@@ -260,6 +303,7 @@ class FaultPlan:
         self.dispatches = tuple(dispatches)
         self.exchanges = tuple(exchanges)
         self.serves = tuple(serves)
+        self.replicas = tuple(replicas)
         self.fired: list[tuple[str, str]] = []
         self._writes = 0
         self._first_seen: dict[int, float] = {}
@@ -267,6 +311,9 @@ class FaultPlan:
         self._dropped: set[int] = set()
         self._killed_dispatcher = False
         self._served_disconnects: set[tuple[str, int]] = set()
+        self._killed_replicas: set[str] = set()
+        self._partitions_fired: set[tuple[str, int]] = set()
+        self._slow_replicas: set[str] = set()
         # set by the hub when the plan is armed in its options: every
         # injection also lands in the telemetry stream as a
         # fault-injected event (docs/telemetry.md), so a chaos run's
@@ -292,6 +339,7 @@ class FaultPlan:
     def armed(self) -> bool:
         return bool(self.spoke_bounds or self.lanes or self.checkpoints
                     or self.dispatches or self.exchanges or self.serves
+                    or self.replicas
                     or self.preempt_at_iter is not None)
 
     # -- seams: serve layer (mpisppy_tpu/serve; docs/serving.md) ----------
@@ -339,6 +387,48 @@ class FaultPlan:
                 self._fire("serve", f"flood {tenant} x{f.flood_factor}")
                 return max(1, int(f.flood_factor))
         return 1
+
+    # -- seams: fleet replicas (mpisppy_tpu/fleet; docs/serving.md) -------
+    def _replica_hits(self, kind: str, rid: str):
+        for f in self.replicas:
+            if f.kind == kind and (not f.replica or f.replica == rid):
+                return f
+        return None
+
+    def replica_kill(self, rid: str, beat: int) -> bool:
+        """True when this replica must die NOW — called from the
+        replica's heartbeat loop; fires once per replica."""
+        f = self._replica_hits("kill", rid)
+        if f is None or rid in self._killed_replicas:
+            return False
+        if beat < (f.at_beats[0] if f.at_beats else 0):
+            return False
+        self._killed_replicas.add(rid)
+        self._fire("replica", f"kill {rid}@beat{beat}")
+        return True
+
+    def replica_partitioned(self, rid: str, beat: int) -> bool:
+        """True while the replica's heartbeats and the router's status
+        probes must be dropped (the partition window)."""
+        f = self._replica_hits("partition", rid)
+        if f is None or beat not in f.at_beats:
+            return False
+        if (rid, beat) not in self._partitions_fired:
+            self._partitions_fired.add((rid, beat))
+            self._fire("replica", f"partition {rid}@beat{beat}")
+        return True
+
+    def replica_beat_delay(self, rid: str) -> float:
+        """Extra per-beat delay (slow_heartbeat); 0.0 unarmed.  Fires
+        into the record once per replica, applies every beat."""
+        f = self._replica_hits("slow_heartbeat", rid)
+        if f is None:
+            return 0.0
+        if rid not in self._slow_replicas:
+            self._slow_replicas.add(rid)
+            self._fire("replica",
+                       f"slow-heartbeat {rid} +{f.delay_s}s")
+        return float(f.delay_s)
 
     # -- seams: async exchange (async_wheel.AsyncFusedPH / AsyncPHHub) ----
     def filter_plane_write(self, hub_iter: int, new_plane, old_plane):

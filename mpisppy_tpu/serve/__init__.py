@@ -26,6 +26,7 @@
 from mpisppy_tpu.serve.admission import (  # noqa: F401
     AdmissionRejected,
     FairQueue,
+    FleetAdmission,
 )
 from mpisppy_tpu.serve.protocol import (  # noqa: F401
     MODELS,

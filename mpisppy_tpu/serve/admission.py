@@ -147,6 +147,33 @@ class FairQueue:
         return [t for t in self._tenants.values()
                 if t.queue and t.inflight < t.quota]
 
+    def _select(self):                 # holds-lock: _lock
+        """The WFQ winner tenant (SLA-class priority + starvation
+        guard + least virtual finish), or None when nothing is
+        eligible."""
+        elig = self._eligible()
+        if not elig:
+            return None
+        lat = [t for t in elig if t.queue[0].sla == "latency"]
+        thr = [t for t in elig if t.queue[0].sla != "latency"]
+        pool = lat or thr
+        if lat and thr and self._latency_run >= self.latency_burst:
+            pool = thr                 # starvation guard: one through
+        return min(pool, key=lambda x: (x.vfinish, x.name))
+
+    def _charge(self, t, session) -> None:   # holds-lock: _lock
+        """Commit an admission: quota slot, WFQ virtual clock, SLA
+        burst counter."""
+        t.inflight += 1
+        t.admitted += 1
+        # WFQ virtual clock: service cost 1 scaled by weight
+        self._vtime = max(self._vtime, t.vfinish)
+        t.vfinish = self._vtime + 1.0 / t.weight
+        if session.sla == "latency":
+            self._latency_run += 1
+        else:
+            self._latency_run = 0
+
     def pop(self):
         """The next session to admit, or None when nothing is eligible
         (empty queues or every queued tenant at quota).  SLA-class
@@ -158,29 +185,14 @@ class FairQueue:
         or skew fairness."""
         with self._lock:
             while True:
-                elig = self._eligible()
-                if not elig:
+                t = self._select()
+                if t is None:
                     return None
-                lat = [t for t in elig if t.queue[0].sla == "latency"]
-                thr = [t for t in elig if t.queue[0].sla != "latency"]
-                pool = lat or thr
-                if lat and thr \
-                        and self._latency_run >= self.latency_burst:
-                    pool = thr         # starvation guard: one through
-                t = min(pool, key=lambda x: (x.vfinish, x.name))
                 session = t.queue.pop(0)
                 self._queued -= 1
                 if session.is_terminal():
                     continue           # reaped while queued: discard
-                t.inflight += 1
-                t.admitted += 1
-                # WFQ virtual clock: service cost 1 scaled by weight
-                self._vtime = max(self._vtime, t.vfinish)
-                t.vfinish = self._vtime + 1.0 / t.weight
-                if session.sla == "latency":
-                    self._latency_run += 1
-                else:
-                    self._latency_run = 0
+                self._charge(t, session)
                 return session
 
     def release(self, session) -> None:
@@ -220,3 +232,49 @@ class FairQueue:
                         "vfinish": round(t.vfinish, 4),
                     } for t in self._tenants.values()},
             }
+
+
+class FleetAdmission(FairQueue):
+    """Placement-aware WFQ for the fleet router (ISSUE 16 tentpole).
+
+    The exact FairQueue policy — WFQ weights, quotas, SLA classes,
+    bounded queues with typed rejection — hoisted ABOVE the replicas
+    (global admission state lives here, each replica's local queue is
+    just a hand-off buffer), plus a placement step fused into pop:
+    the WFQ winner is only charged (quota + virtual clock) once a
+    replica actually accepted it, so a fleet momentarily out of free
+    slots leaves fairness untouched."""
+
+    def pop_placed(self, place_fn):
+        """Pop the WFQ-next session and place it.
+
+        place_fn(session) -> replica-or-None runs OUTSIDE the queue
+        lock (it reads replica load and affinity state).  Returns
+        (session, replica), or (None, None) when nothing is eligible,
+        placement declined (no live replica with a free slot — the
+        session stays at the front of its queue, uncharged), or a
+        concurrent drain raced the candidate away."""
+        with self._lock:
+            while True:
+                t = self._select()
+                if t is None:
+                    return None, None
+                session = t.queue[0]
+                if session.is_terminal():
+                    t.queue.pop(0)     # reaped while queued: discard
+                    self._queued -= 1
+                    continue
+                break
+        replica = place_fn(session)
+        if replica is None:
+            return None, None
+        with self._lock:
+            t2 = self._tenants.get(session.tenant)
+            # commit only if the candidate is still at its queue front
+            # (a drain may have emptied the queue while we placed)
+            if t2 is None or not t2.queue or t2.queue[0] is not session:
+                return None, None
+            t2.queue.pop(0)
+            self._queued -= 1
+            self._charge(t2, session)
+        return session, replica

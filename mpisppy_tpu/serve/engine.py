@@ -111,6 +111,10 @@ class WheelEngine:
         if session.checkpoint_path:
             hub_opts["checkpoint_path"] = session.checkpoint_path
             hub_opts["checkpoint_every_s"] = self.checkpoint_every_s
+        # live-migration drain (ISSUE 16): the hub checks this event at
+        # every sync prologue and raises PreemptionError (emergency
+        # checkpoint) when the fleet router asks the session to move
+        hub_opts["preempt_event"] = session.preempt_event
         if fault_plan is not None:
             hub_opts["fault_plan"] = fault_plan
         if self.multiplexed:
@@ -169,21 +173,23 @@ class SyntheticEngine:
     honors the serve fault seams, in ~iters*step_s wall seconds.  A
     `preempt_at` map {(tenant, ordinal): iter} simulates preemption
     with checkpoint-free resume (the resumed session continues from
-    the recorded iteration)."""
+    the recorded iteration).  The resume cursor lives ON the session
+    (session.resume_iter), so a fleet-migrated session resumes
+    correctly even on a DIFFERENT engine instance — the synthetic
+    analogue of the checkpoint travelling through the shared spool."""
 
     def __init__(self, iters: int = 6, step_s: float = 0.005,
                  preempt_at: dict | None = None):
         self.iters = iters
         self.step_s = step_s
         self.preempt_at = dict(preempt_at or {})
-        self._resume_iter: dict = {}
 
     def run(self, session, ring=None, fault_plan=None) -> tuple:
         if fault_plan is not None:
             fault_plan.serve_before_solve(session.tenant,
                                           session.ordinal)
         key = (session.tenant, session.ordinal)
-        start = self._resume_iter.get(key, 0)
+        start = session.resume_iter
         if start == 0:
             session.bus.emit(tel.RUN_START, run=session.run_id,
                              cyl="hub", hub_class="SyntheticEngine",
@@ -191,6 +197,12 @@ class SyntheticEngine:
         gap0 = 0.20
         target = session.spec.gap_target
         for it in range(start + 1, self.iters + 1):
+            if session.preempt_event.is_set():
+                # migration drain: stop at the iteration boundary, the
+                # synthetic stand-in for the emergency checkpoint
+                session.resume_iter = it - 1
+                return "preempted", {"iter": it - 1,
+                                     "detail": "drain-requested"}
             time.sleep(self.step_s)
             frac = it / self.iters
             rel_gap = gap0 * (1.0 - frac) + target * 0.5 * frac
@@ -201,7 +213,7 @@ class SyntheticEngine:
                 rel_gap=rel_gap)
             if self.preempt_at.get(key) == it:
                 del self.preempt_at[key]     # fire once
-                self._resume_iter[key] = it
+                session.resume_iter = it
                 return "preempted", {"iter": it, "detail": "synthetic"}
         session.bus.emit(tel.RUN_END, run=session.run_id, cyl="hub",
                          hub_iter=self.iters, reason="converged",

@@ -113,6 +113,22 @@ class StructureInterner:
                     "misses": self._misses,
                     "evictions": self._evictions}
 
+    def digests(self) -> tuple:
+        """The content digests currently held (ISSUE 16): the fleet
+        router's placement-affinity key — a session whose canonical
+        structure is already in a replica's pool coalesces there for
+        free, so placement prefers that replica.  Array entries report
+        their sha1 digest, interned objects a stable object key."""
+        with self._lock:
+            out = []
+            for key in self._pool:
+                if key and key[0] == "obj":
+                    out.append("obj:" + hashlib.sha1(
+                        repr(key).encode()).hexdigest()[:16])
+                else:
+                    out.append(str(key[-1])[:16])
+            return tuple(out)
+
 
 #: the process-default interner every serve session shares
 _default_interner = StructureInterner()

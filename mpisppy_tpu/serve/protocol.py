@@ -9,6 +9,10 @@
 #    "deadline_s": 120.0, "args": ["--crops-multiplier", "1"]}
 #   {"op": "ping"}
 #   {"op": "stats"}
+#   {"op": "status"}     (ISSUE 16: lightweight health probe — replica
+#                         id, session counts by state, queue depth,
+#                         free slots, interner digests held; the fleet
+#                         router's health checks ride this op)
 #
 # Server responses: one ack per request ({"ok": true, "session": sid}
 # or {"ok": false, "error": ..., "reason": ...}), then a stream of
@@ -48,6 +52,9 @@ MODELS = {
 
 #: terminal client-visible events — exactly one per session
 TERMINAL_EVENTS = ("done", "failed", "rejected")
+
+#: request ops a server answers (anything else gets a typed error line)
+REQUEST_OPS = ("submit", "ping", "stats", "status")
 
 
 class ProtocolError(ValueError):

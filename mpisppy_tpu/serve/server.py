@@ -63,6 +63,8 @@ class ServeOptions:
     engine: object | None = None     # injectable (tests/chaos)
     fault_plan: object | None = None  # chaos seams (ServeFault et al.)
     bus: object | None = None        # server-level telemetry bus
+    replica_id: str = ""             # fleet replica identity (ISSUE 16;
+                                     # stamped on every session served)
 
 
 class WheelServer:
@@ -243,6 +245,10 @@ class WheelServer:
                 elif op == "stats":
                     self._safe_send(outbox, {"ok": True, "op": "stats",
                                              "stats": self.stats()})
+                elif op == "status":
+                    self._safe_send(outbox, {
+                        "ok": True, "op": "status",
+                        "status": self.status()})
                 elif op == "submit":
                     try:
                         self._handle_submit(msg, outbox, my_sessions)
@@ -291,11 +297,8 @@ class WheelServer:
         session = sess_mod.Session(
             spec, outbox=outbox, server_bus=self.bus,
             trace_dir=self.options.trace_dir)
-        if self.options.spool_dir:
-            session.checkpoint_path = os.path.join(
-                self.options.spool_dir, f"ckpt-{session.sid}.npz")
         try:
-            self.queue.submit(session)
+            self.submit_session(session)
         except adm.AdmissionRejected as e:
             # typed backpressure — the terminal outcome arrives in the
             # SAME ack so a flooding client can never mistake a reject
@@ -309,16 +312,33 @@ class WheelServer:
                                      "error": "rejected",
                                      "reason": e.reason})
             return
+        my_sessions.append(session)
+        self._safe_send(outbox, {"ok": True, "session": session.sid,
+                                 "tenant": spec.tenant})
+
+    def submit_session(self, session) -> None:
+        """Admit an externally-constructed session — the socket submit
+        path above and the fleet router's replica-assignment path
+        (ISSUE 16) share it.  Stamps the replica identity, attaches the
+        per-replica trace and checkpoint spool, and enters admission.
+        Raises adm.AdmissionRejected on backpressure WITHOUT settling
+        the session: the caller owns the typed terminal outcome (the
+        router re-places a migrating session instead of rejecting)."""
+        if self.options.replica_id:
+            session.replica = self.options.replica_id
+        if self.options.trace_dir and not session.trace_attached:
+            session.attach_trace(self.options.trace_dir)
+        if session.checkpoint_path is None and self.options.spool_dir:
+            session.checkpoint_path = os.path.join(
+                self.options.spool_dir, f"ckpt-{session.sid}.npz")
+        self.queue.submit(session)
         with self._lock:
             self._sessions[session.sid] = session
             self._submitted += 1
             self._wake.notify_all()
-        my_sessions.append(session)
         _metrics.REGISTRY.inc("serve_sessions_total")
         _metrics.REGISTRY.set_gauge("serve_queue_depth",
                                     self.queue.stats()["queued"])
-        self._safe_send(outbox, {"ok": True, "session": session.sid,
-                                 "tenant": spec.tenant})
 
     # -- scheduling -------------------------------------------------------
     def _schedule_loop(self):
@@ -482,6 +502,8 @@ class WheelServer:
                            detail="preempted while the server "
                                   "drained; checkpoint retained")
             return
+        if self._preemption_handoff(session, payload):
+            return          # the fleet router took ownership
         self.queue.requeue_front(session)
         with self._lock:
             stopping = self._stopping
@@ -493,6 +515,49 @@ class WheelServer:
             # one) still gets its typed terminal outcome
             for s in self.queue.drain():
                 self._reject(s, "draining")
+
+    def _preemption_handoff(self, session, payload: dict) -> bool:
+        """Fleet seam (ISSUE 16): a replica server overrides this to
+        hand a draining/migrating session back to its router instead
+        of the local queue.  True = the router took ownership (the
+        emergency checkpoint is on disk; the router re-places the
+        session with restore=True on another replica)."""
+        return False
+
+    # -- health probes ----------------------------------------------------
+    def load(self) -> tuple[int, int]:
+        """(running, queued) — the router's cheap placement read."""
+        with self._lock:
+            running = self._running
+        return running, self.queue.stats()["queued"]
+
+    def status(self) -> dict:
+        """Lightweight health probe (ISSUE 16 satellite): replica
+        identity, session counts by state, queue depth, free slots,
+        and the interner digests this replica's engine holds — the
+        placement-affinity key the fleet router routes on.  Cheap
+        enough to answer on every heartbeat probe."""
+        with self._lock:
+            running = self._running
+            stopping = self._stopping
+            states: dict = dict(self._state_totals)
+            for s in self._sessions.values():
+                states[s.state] = states.get(s.state, 0) + 1
+        q = self.queue.stats()
+        out = {
+            "replica": self.options.replica_id,
+            "running": running,
+            "queued": q["queued"],
+            "free_slots": max(0, self.options.max_running - running),
+            "draining": stopping or bool(q.get("draining")),
+            "states": states,
+        }
+        interner = getattr(self.engine, "interner", None)
+        out["interner_digests"] = (
+            list(interner.digests())
+            if interner is not None and hasattr(interner, "digests")
+            else [])
+        return out
 
     # -- stats ------------------------------------------------------------
     def stats(self) -> dict:

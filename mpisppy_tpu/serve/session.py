@@ -110,6 +110,22 @@ class Session:
         self.restore = False       # resume from checkpoint (preemption)
         self.preemptions = 0
         self.checkpoint_path: str | None = None
+        # -- fleet surface (ISSUE 16) -- a session routed through the
+        # fleet router carries its placement identity with it: which
+        # replica currently runs it, its content-addressed routing key,
+        # and how many times it migrated.  preempt_event is the live-
+        # migration drain signal: the hub checks it at every sync
+        # prologue and raises PreemptionError (emergency checkpoint)
+        # when set, so a drain lands at a consistent boundary.
+        self.replica = ""
+        self.structure_key = ""
+        self.migrations = 0
+        self.resume_iter = 0       # engine-agnostic resume cursor
+        self.preempt_event = threading.Event()
+        # invoked (with this session) after settle() delivers the one
+        # terminal outcome — the fleet router's quota-release hook
+        self.on_terminal = None
+        self._trace_sink = None    # guarded-by: _lock
         # Lock discipline (tools/graftlint lock-discipline): lifecycle
         # state and the client outbox are touched from the reader
         # thread, the scheduler thread, the session worker, and the
@@ -123,10 +139,36 @@ class Session:
         self.bus = tel.EventBus()
         self.trace_path = None
         if trace_dir:
-            self.trace_path = os.path.join(
-                trace_dir, f"session-{self.sid}.jsonl")
-            self.bus.subscribe(tel.JsonlSink(self.trace_path))
+            self.attach_trace(trace_dir)
         self.bus.subscribe(_ClientForwardSink(self))
+
+    # -- per-replica trace attachment (ISSUE 16) --------------------------
+    def attach_trace(self, trace_dir: str) -> None:
+        """Subscribe a JsonlSink under trace_dir for this session.  A
+        migrating session detaches from the source replica's trace dir
+        and re-attaches under the destination's, so each replica's
+        trace shows exactly the lifecycle segment it hosted (watch
+        joins the segments on sid + run id)."""
+        self.detach_trace()
+        sink = tel.JsonlSink(os.path.join(
+            trace_dir, f"session-{self.sid}.jsonl"))
+        with self._lock:
+            self._trace_sink = sink
+            self.trace_path = sink.path
+        self.bus.subscribe(sink)
+
+    def detach_trace(self) -> None:
+        with self._lock:
+            sink = self._trace_sink
+            self._trace_sink = None
+        if sink is not None:
+            self.bus.unsubscribe(sink)
+            sink.close()
+
+    @property
+    def trace_attached(self) -> bool:
+        with self._lock:
+            return self._trace_sink is not None
 
     # -- state machine ----------------------------------------------------
     @property
@@ -147,6 +189,8 @@ class Session:
         payload = dict(data)
         payload.update(session=self.sid, tenant=self.tenant,
                        sla=self.sla, state=new_state, prev=old)
+        if self.replica:
+            payload.setdefault("replica", self.replica)
         for bus in (self.bus, self.server_bus):
             if bus is not None:
                 bus.emit(tel.SESSION_STATE, run=self.run_id,
@@ -207,6 +251,12 @@ class Session:
             self.transition(state, **payload)   # QUEUED; others move
         self.send({"event": event, "session": self.sid, **payload})
         self.bus.close()
+        cb = self.on_terminal
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass   # a router hook must never block the delivery
         return True
 
     def seconds(self) -> float | None:

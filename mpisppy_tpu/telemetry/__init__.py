@@ -31,8 +31,9 @@ from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     ADMISSION_REJECTED, BOUND_ACCEPT, BOUND_EVICT, BOUND_REJECT,
     CHECKPOINT_RESTORE, CHECKPOINT_WRITE, CONSOLE, DISPATCH,
     DISPATCH_QUARANTINE, DISPATCH_RETRY, EXCHANGE_OVERLAP,
-    FAULT_INJECTED, HUB_ITERATION, KERNEL_COUNTERS, LANE_QUARANTINE,
-    PLANE_WRITE, PROFILE, RUN_END, RUN_START, SESSION_STATE, SPAN,
+    FAULT_INJECTED, FLEET_PLACEMENT, HUB_ITERATION, KERNEL_COUNTERS,
+    LANE_QUARANTINE, PLANE_WRITE, PROFILE, REPLICA_STATE, RUN_END,
+    RUN_START, SESSION_MIGRATED, SESSION_STATE, SPAN,
     SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG, Event,
     new_run_id,
 )

@@ -106,6 +106,8 @@ class RunModel:
     profiles: list = dataclasses.field(default_factory=list)  # profile evs
     plane_writes: list = dataclasses.field(default_factory=list)
     overlaps: list = dataclasses.field(default_factory=list)  # async rows
+    placements: list = dataclasses.field(default_factory=list)  # fleet
+    migrations: list = dataclasses.field(default_factory=list)  # fleet
 
     def iter_of(self, it: int) -> HubIter:
         if it not in self.iters:
@@ -222,6 +224,10 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
             m.overlaps.append({"iter": it, **data})
         elif kind == ev.PROFILE:
             m.profiles.append({"iter": it, **data})
+        elif kind == ev.FLEET_PLACEMENT:
+            m.placements.append({"iter": it, **data})
+        elif kind == ev.SESSION_MIGRATED:
+            m.migrations.append({"iter": it, **data})
     return m
 
 
@@ -447,6 +453,31 @@ def _resilience_summary(model: RunModel) -> dict:
     }
 
 
+def _fleet_summary(model: RunModel) -> dict | None:
+    """Fleet rows for a session's run (ISSUE 16): where it was placed,
+    how it moved.  None for non-fleet runs (no fleet events rode the
+    trace)."""
+    if not model.placements and not model.migrations:
+        return None
+    chain: list = []
+    for p in model.placements:
+        rep = p.get("replica")
+        if rep and (not chain or chain[-1] != rep):
+            chain.append(rep)
+    policies: dict[str, int] = {}
+    for p in model.placements:
+        pol = p.get("policy", "?")
+        policies[pol] = policies.get(pol, 0) + 1
+    return {
+        "placements": len(model.placements),
+        "policies": policies,
+        "replica_chain": chain,
+        "migrations": len(model.migrations),
+        "migrated_at_iters": [mg.get("iter") for mg in model.migrations
+                              if mg.get("iter") is not None],
+    }
+
+
 def _async_wheel(model: RunModel) -> dict | None:
     """Plane-staleness + host/device overlap attribution for an async
     wheel run (ISSUE 11): how stale the exchange plane actually ran,
@@ -524,6 +555,7 @@ def analyze(model: RunModel) -> dict:
         "resilience": _resilience_summary(model),
         "kernel": model.kernel,
         "async_wheel": _async_wheel(model),
+        "fleet": _fleet_summary(model),
     }
     flags = []
     stall = bounds.get("iters_since_outer_moved")
@@ -712,6 +744,14 @@ def render_report(rep: dict) -> str:
                  + (f"  theta last {_fmt(aw.get('theta_last'), '.3g')}"
                     f"/min {_fmt(aw.get('theta_min'), '.3g')}"
                     if aw.get("theta_last") is not None else ""))
+    fl = rep.get("fleet")
+    if fl:
+        L.append(f"fleet: placements {fl['placements']} "
+                 f"{fl['policies']}  migrations {fl['migrations']}"
+                 + (f"  path {'>'.join(fl['replica_chain'])}"
+                    if fl["replica_chain"] else "")
+                 + (f"  at iters {fl['migrated_at_iters']}"
+                    if fl["migrated_at_iters"] else ""))
     res = rep["resilience"]
     if any(v for v in res.values()):
         L.append(f"resilience: faults {res['faults_injected'] or '{}'}  "

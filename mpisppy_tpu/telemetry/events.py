@@ -53,6 +53,16 @@ ADMISSION_REJECTED = "admission-rejected"  # serve layer: backpressure
                                        # refused a submit with a typed
                                        # reason (queue-full / quota /
                                        # draining) — never a hang
+FLEET_PLACEMENT = "fleet-placement"    # fleet router: a session placed
+                                       # on a replica (policy: affinity
+                                       # on the interner routing key,
+                                       # else least-loaded)
+SESSION_MIGRATED = "session-migrated"  # fleet router: a session moved
+                                       # replicas (emergency checkpoint
+                                       # -> requeue -> restore on the
+                                       # destination; non-terminal)
+REPLICA_STATE = "replica-state"        # fleet health plane: a replica
+                                       # moved UP/SUSPECT/DEAD/DRAINED
 SCENGEN = "scengen"                    # a VirtualBatch was built: the
                                        # program, scenario count, base
                                        # seed, and the resident-vs-

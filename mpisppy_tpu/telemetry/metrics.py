@@ -80,6 +80,13 @@ ALL_METRICS = frozenset({
     "serve_preemptions_total",
     "serve_disconnects_total",
     "serve_failures_total",
+    # replicated serve fleet (mpisppy_tpu/fleet; ISSUE 16)
+    "fleet_replicas_up",
+    "fleet_replica_deaths_total",
+    "fleet_sessions_migrated_total",
+    "fleet_migrations_lost_total",
+    "fleet_placement_affinity_total",
+    "fleet_placement_spill_total",
 })
 
 

@@ -82,6 +82,13 @@ GATES: tuple[tuple[str, str, float], ...] = (
     # multiplies it)
     (r"kernels\..*\.const_bytes$", "up", 0.0),
     (r"kernels\..*\.temp_bytes$", "up", 0.10),
+    # replicated serve fleet (ISSUE 16; BENCH fleet_serve_load phase):
+    # a migration that loses its session is ALWAYS a regression — the
+    # counter must stay 0 (any increase fails).  Latency/isolation on
+    # the fleet phase ride the serve_load\..* and isolation_ratio
+    # patterns above unchanged (the phase is named fleet_serve_load,
+    # and the gates' searches are unanchored).
+    (r"migrations_lost", "up", 0.0),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -126,6 +133,13 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # the S=1M phase — the "as many scenarios as you can imagine"
     # witness — fails as MISSING once an artifact has carried it)
     (r"wheel_scengen\.sweep\.S1000000\.iters_per_sec$", "down", 0.0),
+    # wheel fleet (ISSUE 16 acceptance; docs/serving.md fleet
+    # section): every session a replica death forced to migrate must
+    # still certify to the SAME gap target as the fault-free run —
+    # the migrated-reached-gap fraction is 1.0 or the live-migration
+    # story is fiction
+    (r"fleet_serve_load\.migration\.migrated_reached_gap_frac$",
+     "down", 1.0),
 )
 
 

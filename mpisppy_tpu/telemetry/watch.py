@@ -35,6 +35,10 @@ class WatchState:
         self.sla = None
         self.session = None
         self.session_state = None
+        self.replica = None         # fleet: replica this trace segment
+                                    # rode on (session-state payloads)
+        self.migrations = 0         # fleet: session-migrated events
+                                    # seen in THIS segment
         self.events = 0
         self.last_iter = None
         self.outer = self.inner = self.rel_gap = None
@@ -117,6 +121,14 @@ class WatchState:
             self.sla = data.get("sla", self.sla)
             self.session = data.get("session", self.session)
             self.session_state = data.get("state", self.session_state)
+            self.replica = data.get("replica", self.replica)
+        elif kind == "session-migrated":
+            # fleet: this segment ends here; the destination replica's
+            # segment continues the same (run, session)
+            self.session = data.get("session", self.session)
+            self.tenant = data.get("tenant", self.tenant)
+            self.migrations = max(self.migrations,
+                                  data.get("migrations", 0) or 0)
         elif kind == "profile":
             self.profile_dir = data.get("profile_dir", self.profile_dir)
 
@@ -237,37 +249,100 @@ def _fmt_cell(v, spec=".3g", width=0):
     return s.rjust(width) if width else s
 
 
+def merge_session_rows(states: dict[str, "WatchState"]) -> list[dict]:
+    """Fold per-FILE states into per-SESSION rows.  A fleet-migrated
+    session leaves one trace segment per replica it ran on (the same
+    sid file name under each replica's subdirectory); the segments
+    join on (run id, session id) so the session counts ONCE, with the
+    newest segment supplying its current state and the replica chain
+    recording the journey."""
+    groups: dict = {}
+    for name in sorted(states):
+        st = states[name]
+        key = (st.run, st.session) if st.run and st.session else name
+        groups.setdefault(key, []).append((name, st))
+    rows: list[dict] = []
+    for key in groups:
+        segs = groups[key]
+        # segment order = event recency (the destination segment is
+        # the live one; ties keep listing order)
+        segs = sorted(segs, key=lambda p: p[1].last_event_wall or 0.0)
+        chain = []
+        for name, s in segs:
+            rep = s.replica or os.path.dirname(name) or None
+            if rep and rep not in chain:
+                chain.append(rep)
+        name, prim = segs[-1]
+        iters = [s.last_iter for _, s in segs
+                 if isinstance(s.last_iter, int)]
+        rows.append({
+            "session": prim.session or os.path.basename(name)
+            .replace("session-", "").replace(".jsonl", ""),
+            "tenant": next((s.tenant for _, s in reversed(segs)
+                            if s.tenant), "?"),
+            "sla": next((s.sla for _, s in reversed(segs) if s.sla),
+                        None),
+            "state": prim.session_state,
+            "iter": max(iters) if iters else None,
+            "rel_gap": prim.rel_gap,
+            "sec_per_iter": prim.sec_per_iter,
+            "events": sum(s.events for _, s in segs),
+            "chain": chain,
+            "replica": chain[-1] if chain else None,
+            "migrations": max((s.migrations for _, s in segs),
+                              default=0),
+        })
+    return rows
+
+
 def render_tenant_table(states: dict[str, "WatchState"]) -> str:
     """Per-session table over a directory of per-session traces (the
     serve layer writes one per session; docs/serving.md), grouped by
-    tenant with a per-tenant rollup line."""
+    tenant with a per-tenant rollup line.  Fleet layouts (per-replica
+    subdirectories) get a replica column — `r0>r1` marks a migrated
+    session — and a per-replica summary block."""
     L: list[str] = []
-    L.append(f"{'session':<10} {'tenant':<10} {'sla':<10} {'state':<9} "
-             f"{'iter':>5} {'rel_gap':>9} {'s/iter':>8} {'events':>7}")
+    rows = merge_session_rows(states)
+    fleet = any(r["replica"] for r in rows)
+    rep_w = 9 if fleet else 0
+    head = (f"{'session':<10} {'tenant':<10} {'sla':<10} {'state':<9} "
+            f"{'iter':>5} {'rel_gap':>9} {'s/iter':>8} {'events':>7}")
+    if fleet:
+        head += f" {'replica':<9}"
+    L.append(head)
     by_tenant: dict[str, list] = {}
-    for name in sorted(states):
-        st = states[name]
-        tenant = st.tenant or "?"
-        by_tenant.setdefault(tenant, []).append((name, st))
+    for r in rows:
+        by_tenant.setdefault(r["tenant"], []).append(r)
     for tenant in sorted(by_tenant):
-        rows = by_tenant[tenant]
-        done = sum(1 for _, s in rows
-                   if s.session_state in ("DONE", "FAILED", "REJECTED"))
-        gaps = [s.rel_gap for _, s in rows if s.rel_gap is not None]
-        L.append(f"tenant {tenant}: {len(rows)} session(s), "
+        rows_t = by_tenant[tenant]
+        done = sum(1 for r in rows_t
+                   if r["state"] in ("DONE", "FAILED", "REJECTED"))
+        gaps = [r["rel_gap"] for r in rows_t
+                if r["rel_gap"] is not None]
+        L.append(f"tenant {tenant}: {len(rows_t)} session(s), "
                  f"{done} terminal"
                  + (f", best rel_gap {min(gaps):.3e}" if gaps else ""))
-        for name, s in rows:
-            sid = s.session or name.replace("session-", "") \
-                .replace(".jsonl", "")
-            it = s.last_iter if isinstance(s.last_iter, int) else None
-            L.append(
-                f"  {sid:<8} {tenant:<10} {s.sla or '-':<10} "
-                f"{s.session_state or '-':<9} "
-                f"{_fmt_cell(it, 'd'):>5} "
-                f"{_fmt_cell(s.rel_gap, '.3e'):>9} "
-                f"{_fmt_cell(s.sec_per_iter, '.3g'):>8} "
-                f"{s.events:>7}")
+        for r in sorted(rows_t, key=lambda r: r["session"]):
+            line = (
+                f"  {r['session']:<8} {tenant:<10} "
+                f"{r['sla'] or '-':<10} {r['state'] or '-':<9} "
+                f"{_fmt_cell(r['iter'], 'd'):>5} "
+                f"{_fmt_cell(r['rel_gap'], '.3e'):>9} "
+                f"{_fmt_cell(r['sec_per_iter'], '.3g'):>8} "
+                f"{r['events']:>7}")
+            if fleet:
+                line += f" {'>'.join(r['chain']) or '-':<{rep_w}}"
+            L.append(line)
+    if fleet:
+        reps = sorted({rep for r in rows for rep in r["chain"]})
+        for rid in reps:
+            here = [r for r in rows if r["replica"] == rid]
+            touched = [r for r in rows if rid in r["chain"]]
+            done = sum(1 for r in here
+                       if r["state"] in ("DONE", "FAILED", "REJECTED"))
+            moved = sum(1 for r in touched if len(r["chain"]) > 1)
+            L.append(f"replica {rid}: {len(here)} session(s) "
+                     f"resident, {done} terminal, {moved} migrated")
     if not by_tenant:
         L.append("(no session traces yet)")
     return "\n".join(L)
@@ -278,7 +353,10 @@ def watch_dir(trace_dir: str, interval: float = 2.0,
     """Tail a DIRECTORY of per-session JSONL traces (the serve layer
     writes one per session) and render the per-tenant table.  New
     files are picked up between ticks; each file keeps its own
-    incremental offset."""
+    incremental offset.  A fleet layout — per-replica SUBDIRECTORIES
+    each holding that replica's session traces — is walked one level
+    deep; aggregate streams (fleet.jsonl) are skipped, and a migrated
+    session's segments merge on (run, sid) so it never double-counts."""
     out = out or sys.stdout
     if not os.path.isdir(trace_dir):
         print(f"watch: no trace directory at {trace_dir!r}",
@@ -286,13 +364,31 @@ def watch_dir(trace_dir: str, interval: float = 2.0,
         return 1
     states: dict[str, WatchState] = {}
     offsets: dict[str, int] = {}
+
+    def _scan() -> list[str]:
+        try:
+            entries = sorted(os.listdir(trace_dir))
+        except OSError:
+            return []
+        found: list[str] = []
+        for e in entries:
+            p = os.path.join(trace_dir, e)
+            if e.endswith(".jsonl") and os.path.isfile(p):
+                found.append(e)
+            elif os.path.isdir(p):
+                try:
+                    subs = sorted(os.listdir(p))
+                except OSError:
+                    continue
+                found.extend(os.path.join(e, s) for s in subs
+                             if s.endswith(".jsonl"))
+        session_only = [n for n in found
+                        if os.path.basename(n).startswith("session-")]
+        return session_only or found
+
     try:
         while True:
-            try:
-                names = sorted(n for n in os.listdir(trace_dir)
-                               if n.endswith(".jsonl"))
-            except OSError:
-                names = []
+            names = _scan()
             for n in names:
                 st = states.setdefault(n, WatchState())
                 offsets[n] = _follow(os.path.join(trace_dir, n), st,

@@ -1,0 +1,367 @@
+# Wheel fleet (ISSUE 16; docs/serving.md fleet section): placement-
+# aware global admission (FleetAdmission.pop_placed), structure-affine
+# placement, the router end-to-end over its socket, live session
+# migration off a killed replica, the health plane's UP/SUSPECT/DEAD
+# ladder under the three ReplicaFault seams, and the corrupted-
+# destination checkpoint-restore fallback.
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.fleet import (
+    DEAD, SUSPECT, UP, FleetOptions, FleetRouter, HealthBoard,
+    Replica, choose, routing_key,
+)
+from mpisppy_tpu.resilience.faults import FaultPlan, ReplicaFault
+from mpisppy_tpu.serve import FleetAdmission, SubmitRequest
+from mpisppy_tpu.serve import loadgen
+from mpisppy_tpu.serve.engine import SyntheticEngine, WheelEngine
+from mpisppy_tpu.serve.session import Session
+
+
+def _spec(tenant="acme", **kw):
+    kw.setdefault("model", "farmer")
+    kw.setdefault("num_scens", 3)
+    return SubmitRequest(tenant=tenant, **kw)
+
+
+def _sess(tenant="acme", **kw):
+    s = Session(_spec(tenant, **kw))
+    s.structure_key = routing_key(s.spec)
+    return s
+
+
+class _FakeReplica:
+    """Placement test double: id + free slots + held keys."""
+
+    def __init__(self, rid, free=1, keys=()):
+        self.id = rid
+        self._free = free
+        self._keys = set(keys)
+
+    def free_slots(self):
+        return self._free
+
+    def holds(self, key):
+        return key in self._keys
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+def test_routing_key_is_structure_content_addressed():
+    """Equal (model, scale, args) specs share a key — they intern to
+    the same canonical structure; different scale means a different
+    key."""
+    a = routing_key(_spec("acme", num_scens=4))
+    b = routing_key(_spec("zeta", num_scens=4))     # tenant-agnostic
+    c = routing_key(_spec("acme", num_scens=5))
+    d = routing_key(_spec("acme", num_scens=4, args=("--x", "1")))
+    assert a == b
+    assert a != c and a != d
+
+
+def test_placement_prefers_affinity_then_least_loaded():
+    s = _sess("acme", num_scens=4)
+    key = s.structure_key
+    busy_with_key = _FakeReplica("r0", free=1, keys=(key,))
+    idle_without = _FakeReplica("r1", free=3)
+    rep, policy = choose(s, [busy_with_key, idle_without])
+    assert rep is busy_with_key and policy == "affinity"
+    # no key held anywhere: most free slots wins, id breaks ties
+    rep, policy = choose(s, [_FakeReplica("r0", 1),
+                             _FakeReplica("r1", 3)])
+    assert rep.id == "r1" and policy == "least-loaded"
+    rep, _ = choose(s, [_FakeReplica("r0", 2), _FakeReplica("r1", 2)])
+    assert rep.id == "r1"          # deterministic tie-break
+    assert choose(s, []) == (None, "none")
+
+
+# ---------------------------------------------------------------------------
+# fused WFQ pop + placement
+# ---------------------------------------------------------------------------
+def test_pop_placed_declined_placement_leaves_session_uncharged():
+    """No live replica with a free slot: the session must stay at its
+    queue front UNCHARGED (quota and virtual clock untouched), and the
+    next pop with capacity gets it."""
+    q = FleetAdmission(max_queued=8, default_quota=2)
+    s = _sess("acme")
+    q.submit(s)
+    got, rep = q.pop_placed(lambda _s: None)
+    assert got is None and rep is None
+    st = q.stats()["tenants"]["acme"]
+    assert st["queued"] == 1 and st["inflight"] == 0
+    target = _FakeReplica("r0", free=1)
+    got, rep = q.pop_placed(lambda _s: target)
+    assert got is s and rep is target
+    st = q.stats()["tenants"]["acme"]
+    assert st["queued"] == 0 and st["inflight"] == 1
+
+
+def test_pop_placed_aborts_when_drain_races_the_candidate():
+    """A drain emptying the queue between placement and commit must
+    void the pop — no charge, no ghost session."""
+    q = FleetAdmission(max_queued=8, default_quota=2)
+    s = _sess("acme")
+    q.submit(s)
+
+    def place(sess):
+        drained = q.drain()            # the race, deterministically
+        assert drained == [s]
+        return _FakeReplica("r0", free=1)
+
+    got, rep = q.pop_placed(place)
+    assert got is None and rep is None
+    assert q.stats()["tenants"]["acme"]["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# health board
+# ---------------------------------------------------------------------------
+def test_health_ladder_and_sticky_death():
+    hb = HealthBoard()
+    assert hb.state("r0") == UP
+    # stale beats but the probe answers: degraded, not dead
+    assert hb.observe("r0", fresh=False, probe_ok=True) == SUSPECT
+    # beats resume: recovered
+    assert hb.observe("r0", fresh=True) == UP
+    # stale AND probe fails: dead, and DEAD is sticky (fencing) —
+    # a partitioned replica reappearing is never readmitted
+    assert hb.observe("r0", fresh=False, probe_ok=False) == DEAD
+    assert hb.observe("r0", fresh=True) is None
+    assert hb.state("r0") == DEAD
+    assert hb.snapshot() == {"r0": DEAD}
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end (SyntheticEngine replicas over real sockets)
+# ---------------------------------------------------------------------------
+def _start_fleet(tmp_path, n=2, iters=8, step_s=0.01, fault_plan=None,
+                 **opts_kw):
+    opts_kw.setdefault("trace_dir", str(tmp_path / "traces"))
+    opts_kw.setdefault("spool_dir", str(tmp_path / "spool"))
+    opts_kw.setdefault("heartbeat_s", 0.05)
+    return FleetRouter(FleetOptions(
+        n_replicas=n, max_running_per_replica=2,
+        engine_factory=lambda rid: SyntheticEngine(iters=iters,
+                                                   step_s=step_s),
+        fault_plan=fault_plan, **opts_kw)).start()
+
+
+def _drive(router, n_sessions, timeout=30.0, tenants=("t0", "t1")):
+    """Submit n sessions and stream to terminal; returns {sid:
+    [events...]} keyed in arrival order."""
+    cl = loadgen.ServeClient(router.address, timeout=timeout)
+    acks = [cl.submit(_spec(tenants[i % len(tenants)]))
+            for i in range(n_sessions)]
+    assert all(a.get("ok") for a in acks), acks
+    terminal = {}
+    for msg in cl.stream():
+        if msg.get("event") in ("done", "failed", "rejected"):
+            terminal.setdefault(msg["session"], []).append(msg)
+            if len(terminal) == n_sessions:
+                break
+    cl.close()
+    return terminal
+
+
+def test_fleet_router_serves_and_reports(tmp_path):
+    """Plain traffic through the router: every session lands DONE on
+    some replica, the status/stats ops answer over the socket, and
+    each session got exactly one fleet-placement event."""
+    router = _start_fleet(tmp_path)
+    try:
+        terminal = _drive(router, 6)
+        assert all(v[0]["event"] == "done" for v in terminal.values())
+        cl = loadgen.ServeClient(router.address)
+        cl.send({"op": "status"})
+        st = cl.recv()["status"]
+        assert set(st["replicas"]) == {"r0", "r1"}
+        assert all(r["alive"] for r in st["replicas"].values())
+        cl.send({"op": "stats"})
+        stats = cl.recv()["stats"]
+        assert stats["states"].get("DONE", 0) == 6
+        assert stats["migration"]["lost"] == 0
+        cl.close()
+    finally:
+        router.stop()
+    fleet_log = tmp_path / "traces" / "fleet.jsonl"
+    placements = [json.loads(ln) for ln in
+                  fleet_log.read_text().splitlines()
+                  if json.loads(ln)["kind"] == "fleet-placement"]
+    assert len(placements) == 6
+    # per-replica trace subdirectories carry the session traces
+    placed_reps = {p["data"]["replica"] for p in placements}
+    for rid in placed_reps:
+        assert list((tmp_path / "traces" / rid).glob("session-*.jsonl"))
+
+
+def test_fleet_kill_replica_live_migrates_running_sessions(tmp_path):
+    """The tentpole acceptance in miniature: r0 dies mid-traffic, its
+    running sessions drain through the emergency-checkpoint hand-off
+    and finish on r1 — every session exactly one terminal outcome,
+    zero migrations lost, and the migrated sessions' resume cursors
+    carried (SyntheticEngine resumes from session.resume_iter on a
+    DIFFERENT engine instance)."""
+    plan = FaultPlan(replicas=(
+        ReplicaFault("kill", replica="r0", at_beats=(4,)),))
+    router = _start_fleet(tmp_path, iters=40, step_s=0.02,
+                          fault_plan=plan)
+    try:
+        terminal = _drive(router, 6, timeout=60.0)
+        assert all(len(v) == 1 for v in terminal.values()), terminal
+        assert all(v[0]["event"] == "done" for v in terminal.values())
+        stats = router.stats()
+        mig = stats["migration"]
+        assert mig["started"] >= 1, "kill landed after traffic: " \
+            "no migration exercised"
+        assert mig["completed"] == mig["started"]
+        assert mig["lost"] == 0
+        assert stats["health"]["r0"] == DEAD
+    finally:
+        router.stop()
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "traces" / "fleet.jsonl")
+            .read_text().splitlines()]
+    migrated = {r["data"]["session"] for r in rows
+                if r["kind"] == "session-migrated"}
+    assert migrated
+    # exactly one terminal session-state row per session fleet-wide
+    terminals = {}
+    for r in rows:
+        if r["kind"] == "session-state" and \
+                r["data"].get("state") in ("DONE", "FAILED",
+                                           "REJECTED"):
+            sid = r["data"]["session"]
+            terminals[sid] = terminals.get(sid, 0) + 1
+    assert all(n == 1 for n in terminals.values()), terminals
+    # a migrated session's trace is split across BOTH replicas'
+    # subdirectories (source segment + destination segment)
+    sid = sorted(migrated)[0]
+    assert (tmp_path / "traces" / "r0" / f"session-{sid}.jsonl").exists()
+    assert (tmp_path / "traces" / "r1" / f"session-{sid}.jsonl").exists()
+
+
+def test_fleet_partition_fences_and_drains(tmp_path):
+    """A partitioned replica (beats AND probes suppressed) goes DEAD
+    after the miss budget, its sessions migrate, and it stays fenced
+    even after the partition window ends."""
+    plan = FaultPlan(replicas=(
+        ReplicaFault("partition", replica="r0",
+                     at_beats=tuple(range(3, 100))),))
+    router = _start_fleet(tmp_path, iters=40, step_s=0.02,
+                          fault_plan=plan)
+    try:
+        terminal = _drive(router, 4, timeout=60.0)
+        assert all(v[0]["event"] == "done" for v in terminal.values())
+        stats = router.stats()
+        assert stats["health"]["r0"] == DEAD
+        assert stats["migration"]["lost"] == 0
+        assert not router.replicas[0].alive()     # fenced for good
+    finally:
+        router.stop()
+
+
+def test_fleet_slow_heartbeat_is_suspect_not_dead(tmp_path):
+    """A slow-but-alive replica (delayed beats, answering probes) is
+    at worst SUSPECT: no fencing, no migration, traffic completes."""
+    plan = FaultPlan(replicas=(
+        ReplicaFault("slow_heartbeat", replica="r0", delay_s=0.4),))
+    router = _start_fleet(tmp_path, iters=10, step_s=0.01,
+                          fault_plan=plan)
+    try:
+        terminal = _drive(router, 4, timeout=60.0)
+        assert all(v[0]["event"] == "done" for v in terminal.values())
+        time.sleep(0.5)                 # a few monitor cycles
+        stats = router.stats()
+        assert stats["health"].get("r0") in (None, UP, SUSPECT)
+        assert stats["migration"]["started"] == 0
+        assert router.replicas[0].alive()
+    finally:
+        router.stop()
+
+
+def test_fleet_typed_backpressure_and_drain(tmp_path):
+    """Global queue caps reject typed at the ROUTER (replica queues
+    are non-binding), and stop() settles queued sessions typed."""
+    router = _start_fleet(tmp_path, n=1, iters=200, step_s=0.02,
+                          max_queued=2, max_queued_per_tenant=2,
+                          tenant_quota=1)
+    try:
+        cl = loadgen.ServeClient(router.address, timeout=30.0)
+        acks = [cl.submit(_spec("flood")) for _ in range(6)]
+        rejected = [a for a in acks if not a.get("ok")]
+        assert rejected
+        assert all(a["error"] == "rejected" and a["reason"] in
+                   ("queue-full", "tenant-queue-full")
+                   for a in rejected)
+        cl.close()
+    finally:
+        router.stop()
+    # nothing non-terminal survives stop()
+    assert all(s.is_terminal()
+               for s in router._sessions.values())
+
+
+# ---------------------------------------------------------------------------
+# corrupted-destination restore (satellite): the migration target must
+# survive a corrupt newest snapshot via the rotation fallback
+# ---------------------------------------------------------------------------
+class _Cap:
+    def __init__(self):
+        self.events = []
+
+    def handle(self, event):
+        self.events.append(event)
+
+    def close(self):
+        pass
+
+
+def test_migration_restore_falls_back_past_corrupt_newest(tmp_path):
+    """Two preemptions on the source engine leave a rotated snapshot
+    pair (ckpt @ iter_b, ckpt.1 @ iter_a) in the shared spool.  The
+    newest is then corrupted in place (payload flipped, stale CRC) —
+    exactly the torn-migration hazard.  The DESTINATION replica's
+    engine must reject it on CRC, fall back to the older rotation
+    slot, emit checkpoint-restore with fallback=True, and still finish
+    the session."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    path = str(spool / "ckpt-mig.npz")
+    sess = Session(_spec(tenant="acme", gap_target=0.01,
+                         max_iterations=150))
+    sess.checkpoint_path = path
+
+    src = WheelEngine(multiplexed=False)
+    v, _ = src.run(sess, fault_plan=FaultPlan(seed=5,
+                                              preempt_at_iter=3))
+    assert v == "preempted"
+    sess.restore = True
+    v, _ = src.run(sess, fault_plan=FaultPlan(seed=5,
+                                              preempt_at_iter=7))
+    assert v == "preempted"
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+
+    # corrupt the NEWEST snapshot: perturb a state leaf but keep the
+    # stored CRC — np.load succeeds, the integrity check must not
+    with np.load(path) as d:
+        arrays = {k: np.array(d[k]) for k in d.files}
+    arrays["leaf0"] = arrays["leaf0"] + 1.0
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+    cap = _Cap()
+    sess.bus.subscribe(cap)
+    dst = WheelEngine(multiplexed=False)    # a DIFFERENT engine
+    v, payload = dst.run(sess)
+    assert v == "done"
+    assert payload["rel_gap"] <= 0.01 + 1e-9
+    restores = [e for e in cap.events
+                if e.kind == "checkpoint-restore"]
+    assert restores, "no restore event: the destination never loaded"
+    assert restores[0].data.get("fallback") is True
+    assert restores[0].data.get("path", "").endswith(".1")

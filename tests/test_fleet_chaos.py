@@ -1,0 +1,148 @@
+# Fleet chaos storm (ISSUE 16 acceptance): seeded replica-fault mixes
+# — kill one replica mid-traffic, slow another's heartbeat — against a
+# running 3-replica FleetRouter with live traffic.  The fleet
+# invariant under all of it: every submitted session observes EXACTLY
+# ONE terminal outcome (the settle latch holds across the migration
+# hand-off), killed-replica sessions live-migrate and finish (zero
+# migrations lost), the dead replica is fenced, and global quotas are
+# fully restored.  Fast 2-seed subset in tier-1, 12-seed soak under
+# `slow`.
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.fleet import DEAD, FleetOptions, FleetRouter
+from mpisppy_tpu.resilience.faults import FaultPlan, ReplicaFault
+from mpisppy_tpu.serve import SubmitRequest
+from mpisppy_tpu.serve import loadgen
+from mpisppy_tpu.serve.engine import SyntheticEngine
+
+pytestmark = pytest.mark.chaos
+
+
+def run_fleet_storm(seed: int, tmp_path) -> dict:
+    """One seeded storm round: 3 replicas, 6 concurrent slots, all
+    busy when a seed-chosen replica dies (its beat loop stops a few
+    beats in) and a second replica turns slow-but-alive.  Healthy
+    tenants acme/zeta stream their sessions to terminal through it
+    all."""
+    rng = np.random.default_rng(seed)
+    kill_rid = f"r{int(rng.integers(0, 3))}"
+    slow_rid = f"r{(int(kill_rid[1:]) + 1) % 3}"
+    plan = FaultPlan(seed=seed, replicas=(
+        ReplicaFault("kill", replica=kill_rid,
+                     at_beats=(int(rng.integers(3, 6)),)),
+        ReplicaFault("slow_heartbeat", replica=slow_rid,
+                     delay_s=0.15),
+    ))
+    router = FleetRouter(FleetOptions(
+        unix_path=str(tmp_path / f"fleet{seed}.sock"),
+        n_replicas=3, max_running_per_replica=2,
+        max_queued=32, max_queued_per_tenant=16, tenant_quota=4,
+        trace_dir=str(tmp_path / f"traces{seed}"),
+        spool_dir=str(tmp_path / f"spool{seed}"),
+        heartbeat_s=0.05, drain_grace_s=10.0,
+        default_deadline_s=30.0,
+        engine_factory=lambda rid: SyntheticEngine(iters=40,
+                                                   step_s=0.02),
+        fault_plan=plan)).start()
+
+    records: list = []
+    rec_lock = threading.Lock()
+
+    def client(tenant):
+        cl = loadgen.ServeClient(router.address, timeout=45.0)
+        try:
+            for k in range(2):
+                rec = loadgen.run_session(cl, SubmitRequest(
+                    tenant=tenant, model="farmer", num_scens=3,
+                    sla="latency" if k == 0 else "throughput"))
+                with rec_lock:
+                    records.append(rec)
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in ("acme", "acme", "acme", "zeta", "zeta",
+                         "zeta")]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    wall = time.perf_counter() - t0
+    alive = [t.name for t in threads if t.is_alive()]
+    # settle server-side terminal accounting before the asserts
+    deadline = time.perf_counter() + 15.0
+    while time.perf_counter() < deadline:
+        states = router.stats()["states"]
+        nonterminal = sum(v for k, v in states.items()
+                          if k not in ("DONE", "FAILED", "REJECTED"))
+        if nonterminal == 0:
+            break
+        time.sleep(0.05)
+    stats = router.stats()
+    sessions = dict(router._sessions)
+    router.stop()
+    fleet_log = tmp_path / f"traces{seed}" / "fleet.jsonl"
+    rows = [json.loads(ln)
+            for ln in fleet_log.read_text().splitlines()]
+    return {"seed": seed, "plan": plan, "kill_rid": kill_rid,
+            "records": records, "stats": stats, "wall": wall,
+            "alive": alive, "sessions": sessions, "rows": rows}
+
+
+def assert_fleet_storm_invariants(r: dict) -> None:
+    seed = r["seed"]
+    assert not r["alive"], \
+        f"DEADLOCK: {r['alive']} still alive (seed {seed})"
+    # every client record terminal; healthy traffic all DONE (caps are
+    # wide, the only disruption is the replica fault mix)
+    assert len(r["records"]) == 12
+    for rec in r["records"]:
+        assert rec["outcome"] == "done", (seed, rec)
+    # the kill fired and the replica is fenced
+    assert any(s == "replica" and
+               d.startswith(f"kill {r['kill_rid']}@")
+               for s, d in r["plan"].fired), r["plan"].fired
+    assert r["stats"]["health"][r["kill_rid"]] == DEAD
+    # live migration exercised, nothing lost
+    mig = r["stats"]["migration"]
+    assert mig["started"] >= 1, \
+        f"seed {seed}: kill landed after traffic, nothing migrated"
+    assert mig["completed"] == mig["started"]
+    assert mig["lost"] == 0
+    # EXACTLY ONE terminal session-state row per session fleet-wide —
+    # the exactly-once delivery contract across the hand-off races
+    terminals: dict = {}
+    for row in r["rows"]:
+        d = row.get("data", {})
+        if row["kind"] == "session-state" and \
+                d.get("state") in ("DONE", "FAILED", "REJECTED"):
+            terminals[d["session"]] = terminals.get(d["session"], 0) + 1
+    assert len(terminals) == 12
+    assert all(n == 1 for n in terminals.values()), \
+        (seed, {k: v for k, v in terminals.items() if v > 1})
+    # every server-side session terminal; global quota fully restored
+    for s in r["sessions"].values():
+        assert s.state in ("DONE", "FAILED", "REJECTED"), \
+            (seed, s.sid, s.tenant, s.state)
+    for name, t in r["stats"]["admission"]["tenants"].items():
+        assert t["inflight"] == 0, (seed, name, t)
+    assert r["wall"] < 60.0
+
+
+def test_fleet_chaos_kill_replica_fast_seeded(tmp_path):
+    """Tier-1 subset: two seeded storms (~15s wall together)."""
+    for seed in (7, 31):
+        assert_fleet_storm_invariants(run_fleet_storm(seed, tmp_path))
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak(tmp_path):
+    """The long soak across the replica-fault mix space."""
+    for seed in range(500, 512):
+        assert_fleet_storm_invariants(run_fleet_storm(seed, tmp_path))

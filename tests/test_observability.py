@@ -317,13 +317,17 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     # a post-win artifact meets the floors in strict mode... (strict
     # requires EVERY milestone phase present, so the synthetic post-win
     # artifact also carries the ISSUE-11 async-overhead phase, the
-    # ISSUE-12 serve isolation phase, and the ISSUE-14 scengen phase)
+    # ISSUE-12 serve isolation phase, the ISSUE-14 scengen phase, and
+    # the ISSUE-16 fleet migration phase)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
     won["parsed"]["wheel_overhead_async"] = {"overhead_factor": 1.25}
     won["parsed"]["serve_load"] = {
         "isolation": {"isolation_ratio": 1.0}}
+    won["parsed"]["fleet_serve_load"] = {
+        "isolation": {"isolation_ratio": 1.0},
+        "migration": {"migrated_reached_gap_frac": 1.0}}
     won["parsed"]["wheel_scengen"] = {
         "synth_vs_materialized_ratio": 0.97,
         "sweep": [{"scenarios": 1_000_000, "iters_per_sec": 0.07}]}
@@ -744,3 +748,158 @@ def test_watch_once_cli_on_fault_domain_trace(tmp_path):
     assert out.returncode == 0, out.stderr[-2000:]
     assert "RUN ENDED: max-iter" in out.stdout
     assert "quarantined lanes 3" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# fleet layouts in `watch --trace-dir` (ISSUE 16 satellite): a migrated
+# session's trace is split across two replicas' subdirectories, with
+# the destination tail torn mid-migration
+# ---------------------------------------------------------------------------
+def _jl(path, rows, torn_last=False):
+    with open(path, "w") as f:
+        for i, row in enumerate(rows):
+            line = json.dumps(row)
+            if torn_last and i == len(rows) - 1:
+                f.write(line[: len(line) // 2])   # torn, no newline
+            else:
+                f.write(line + "\n")
+
+
+def test_watch_merges_migrated_session_across_replicas(tmp_path):
+    """A fleet trace dir: session s01 started on r0, migrated to r1
+    (the r1 segment's terminal line is TORN mid-write), s02 lives only
+    on r1.  The watcher must join the s01 segments on (run, sid) into
+    ONE row — never double-counting the session — render the replica
+    chain `r0>r1`, and pick up the torn terminal once completed."""
+    from mpisppy_tpu.telemetry import watch as w
+
+    run = "run-fleet-1"
+    td = tmp_path / "traces"
+    (td / "r0").mkdir(parents=True)
+    (td / "r1").mkdir()
+    # aggregate router stream: must be SKIPPED by the dir walker
+    _jl(td / "fleet.jsonl",
+        [{"kind": "fleet-placement", "run": run, "t_wall": 99.0,
+          "data": {"session": "s01", "replica": "r0"}}])
+    _jl(td / "r0" / "session-s01.jsonl", [
+        {"kind": "session-state", "run": run, "t_wall": 100.0,
+         "data": {"session": "s01", "tenant": "acme", "sla": "latency",
+                  "state": "RUNNING", "replica": "r0"}},
+        {"kind": "hub-iteration", "run": run, "t_wall": 100.5,
+         "t_mono": 1.0, "data": {"iter": 3, "rel_gap": 0.5}},
+        {"kind": "session-migrated", "run": run, "t_wall": 101.0,
+         "data": {"session": "s01", "tenant": "acme", "migrations": 1,
+                  "from_replica": "r0", "iter": 3}},
+    ])
+    s01_r1 = [
+        {"kind": "session-state", "run": run, "t_wall": 102.0,
+         "data": {"session": "s01", "tenant": "acme", "sla": "latency",
+                  "state": "RUNNING", "replica": "r1"}},
+        {"kind": "hub-iteration", "run": run, "t_wall": 102.5,
+         "t_mono": 2.0, "data": {"iter": 7, "rel_gap": 0.008}},
+        {"kind": "session-state", "run": run, "t_wall": 103.0,
+         "data": {"session": "s01", "tenant": "acme",
+                  "state": "DONE", "replica": "r1"}},
+    ]
+    _jl(td / "r1" / "session-s01.jsonl", s01_r1, torn_last=True)
+    _jl(td / "r1" / "session-s02.jsonl", [
+        {"kind": "session-state", "run": run, "t_wall": 102.2,
+         "data": {"session": "s02", "tenant": "zeta", "sla": "batch",
+                  "state": "DONE", "replica": "r1"}},
+    ])
+
+    states: dict = {}
+    offsets: dict = {}
+    for name in ("r0/session-s01.jsonl", "r1/session-s01.jsonl",
+                 "r1/session-s02.jsonl"):
+        st = states.setdefault(name, w.WatchState())
+        offsets[name] = w._follow(str(td / name), st, 0)
+
+    rows = {r["session"]: r for r in w.merge_session_rows(states)}
+    assert set(rows) == {"s01", "s02"}        # s01 joined, counted ONCE
+    s01 = rows["s01"]
+    assert s01["chain"] == ["r0", "r1"]
+    assert s01["replica"] == "r1"             # newest segment wins
+    assert s01["state"] == "RUNNING"          # torn DONE not consumed
+    assert s01["iter"] == 7                   # max across segments
+    assert s01["migrations"] == 1
+    assert s01["events"] == 5                 # 3 (r0) + 2 complete (r1)
+    assert rows["s02"]["chain"] == ["r1"]
+
+    table = w.render_tenant_table(states)
+    assert table.count("s01") == 1            # one row, no double-count
+    assert "r0>r1" in table
+    assert "replica r0: 0 session(s) resident, 0 terminal, 1 migrated" \
+        in table
+    assert "replica r1: 2 session(s) resident, 1 terminal, 1 migrated" \
+        in table
+
+    # the writer finishes the torn terminal line: the tailer resumes
+    # from its offset and the session lands DONE, seen exactly once
+    full = json.dumps(s01_r1[-1])
+    with open(td / "r1" / "session-s01.jsonl", "a") as f:
+        f.write(full[len(full) // 2:] + "\n")
+    name = "r1/session-s01.jsonl"
+    w._follow(str(td / name), states[name], offsets[name])
+    rows = {r["session"]: r for r in w.merge_session_rows(states)}
+    assert rows["s01"]["state"] == "DONE"
+    assert rows["s01"]["events"] == 6
+
+    # the CLI dir mode walks one level deep and skips fleet.jsonl
+    import io
+    buf = io.StringIO()
+    assert w.watch_dir(str(td), once=True, out=buf) == 0
+    out = buf.getvalue()
+    assert "r0>r1" in out and out.count("s01") == 1
+    assert "fleet" not in out                 # aggregate stream skipped
+
+
+def test_gate_r09_r10_fleet_keys_and_migration_milestone(tmp_path):
+    """ISSUE 16 gate fixture: the committed r09->r10 pair gates green
+    with the fleet phase's latency/isolation keys riding the existing
+    serve_load patterns; fleet_migrations_lost_total carries an
+    any-increase gate (must stay 0) and migrated_reached_gap_frac a
+    1.0 ratchet MILESTONE the committed artifact binds."""
+    r09 = os.path.join(REPO, "BENCH_r09.json")
+    r10 = os.path.join(REPO, "BENCH_r10.json")
+    rep = regress.gate_paths(r09, r10)
+    assert rep["ok"], rep["regressions"]
+    ms = {r["metric"]: r for r in rep["milestones"]}
+    mig = ms["fleet_serve_load.migration.migrated_reached_gap_frac"]
+    assert mig["status"] == "met" and mig["milestone"] == 1.0
+
+    # a later round LOSING a migrated session fails on the
+    # any-increase gate even though the baseline value is 0
+    lost = json.load(open(r10))
+    lost["parsed"]["fleet_serve_load"]["migration"][
+        "fleet_migrations_lost_total"] = 1
+    lost_path = tmp_path / "BENCH_lost.json"
+    lost_path.write_text(json.dumps(lost))
+    rep2 = regress.gate_paths(r10, str(lost_path))
+    assert not rep2["ok"]
+    assert any("migrations_lost" in r["metric"]
+               for r in rep2["regressions"])
+
+    # fleet p99 regressing past +-25% fails via the serve_load
+    # latency pattern (unanchored search covers fleet_serve_load)
+    slow = json.load(open(r10))
+    slow["parsed"]["fleet_serve_load"]["time_to_gap_p99_s"] *= 1.5
+    slow_path = tmp_path / "BENCH_fleet_slow.json"
+    slow_path.write_text(json.dumps(slow))
+    rep3 = regress.gate_paths(r10, str(slow_path))
+    assert not rep3["ok"]
+    assert any(r["metric"] ==
+               "fleet_serve_load.time_to_gap_p99_s"
+               for r in rep3["regressions"])
+
+    # ...and the bound migration milestone RATCHETS: a fleet round
+    # where a migrated session misses its gap fails from then on
+    miss = json.load(open(r10))
+    miss["parsed"]["fleet_serve_load"]["migration"][
+        "migrated_reached_gap_frac"] = 0.5
+    miss_path = tmp_path / "BENCH_mig_miss.json"
+    miss_path.write_text(json.dumps(miss))
+    rep4 = regress.gate_paths(r10, str(miss_path))
+    assert not rep4["ok"]
+    assert any("migrated_reached_gap_frac" in r["metric"]
+               for r in rep4["regressions"])

@@ -376,6 +376,50 @@ def test_typed_rejection_and_disconnect_paths(tmp_path):
         srv.stop()
 
 
+def test_status_op_over_the_socket(tmp_path):
+    """The `status` request (ISSUE 16 satellite): a plain client gets
+    the replica's health summary over the wire — session counts by
+    state, queue depth, free slots, and the engine's interner digests
+    (the fleet router's placement/health probe rides this op)."""
+    eng = SyntheticEngine(iters=30, step_s=0.01)
+    srv = _start_server(tmp_path, engine=eng, max_running=1,
+                        max_queued=8, replica_id="r7")
+    try:
+        cl = loadgen.ServeClient(srv.address)
+        for _ in range(3):
+            assert cl.submit(_spec(tenant="acme")).get("ok")
+        cl.send({"op": "status"})
+        msg = cl.recv()
+        while msg.get("event") is not None or "status" not in msg:
+            msg = cl.recv()     # skip interleaved session events
+        assert msg["ok"] and msg["op"] == "status"
+        st = msg["status"]
+        assert st["replica"] == "r7"
+        assert st["running"] + st["queued"] == 3
+        assert st["free_slots"] == 0
+        assert st["draining"] is False
+        assert sum(st["states"].values()) == 3
+        # the WheelEngine variant carries interner digests (the
+        # structure-affinity routing signal); the synthetic one has no
+        # interner and reports the empty tuple
+        assert st["interner_digests"] == []
+        cl.close()
+    finally:
+        srv.stop()
+    # an engine WITH an interner reports its digests through the same
+    # status surface (the structure-affinity routing signal)
+    intern = multiplex.StructureInterner()
+    intern.intern(np.arange(3.0))
+    assert len(intern.digests()) == 1
+    eng2 = WheelEngine(multiplexed=True, interner=intern)
+    srv2 = _start_server(tmp_path / "m", engine=eng2, multiplex=True)
+    try:
+        assert srv2.status()["interner_digests"] == \
+            list(intern.digests())
+    finally:
+        srv2.stop()
+
+
 def test_bad_session_args_fail_typed_not_hang(tmp_path):
     """Client-supplied session args that argparse rejects (SystemExit,
     a BaseException) must surface as a typed terminal `failed` — not a

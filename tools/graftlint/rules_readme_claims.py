@@ -8,7 +8,7 @@
 # (the block opening with "Measured on" and closing at "Out of scope")
 # must trace to a committed benchmark artifact: a numeric field of
 # BENCH_DETAIL.json, DEVICE_PROFILE.json (trace-derived device
-# profiles, ISSUE 7) or any BENCH_r0N.json (including numbers inside a
+# profiles, ISSUE 7) or any BENCH_rNN.json (including numbers inside a
 # wrapper's possibly-truncated stdout `tail`).  "Performance number"
 # means a number carrying a perf unit — seconds, x-factors, percents,
 # iterations, iters/s, TFLOPs, GB/s; config numbers ("900 scenarios",
@@ -89,7 +89,7 @@ def _collect_numbers(obj, pool: set) -> None:
 
 def artifact_pool(repo: str) -> set:
     pool: set = set()
-    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json")))
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json")))
     for extra in ("BENCH_DETAIL.json", "DEVICE_PROFILE.json"):
         p = os.path.join(repo, extra)
         if os.path.exists(p):
@@ -192,7 +192,7 @@ def check_readme(readme_path: str, pool: set) -> list[Finding]:
             out.append(Finding(
                 RULE_NAME, rel, lineno,
                 f"perf claim {display!r} has no witness in "
-                f"BENCH_DETAIL.json / BENCH_r0*.json / "
+                f"BENCH_DETAIL.json / BENCH_r[0-9]*.json / "
                 f"DEVICE_PROFILE.json — quote the committed artifact, "
                 f"not a local run",
                 key=f"claim::{display}"))

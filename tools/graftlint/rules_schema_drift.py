@@ -19,7 +19,7 @@
 #      anchors them for humans).
 #   4. GATE KEYS — every GATES/MILESTONES pattern in
 #      telemetry/regress.py must match at least one metric key
-#      produced by a COMMITTED artifact: the BENCH_r0*/BENCH_DETAIL/
+#      produced by a COMMITTED artifact: the BENCH_r*/BENCH_DETAIL/
 #      DEVICE_PROFILE/SSLP_CERT/KERNEL_IR JSON files plus analyzer
 #      reports derived from the committed tests/fixtures/
 #      golden_*.jsonl traces.  A gate nothing can produce is dead
@@ -206,7 +206,7 @@ def _load_by_path(ctx: Context, rel: str, name: str):
 
 def committed_key_pool(ctx: Context, regress) -> set[str]:
     pool: set[str] = set()
-    for pat in ("BENCH_r0*.json", "BENCH_DETAIL.json",
+    for pat in ("BENCH_r[0-9]*.json", "BENCH_DETAIL.json",
                 "DEVICE_PROFILE.json", "SSLP_CERT.json",
                 "KERNEL_IR.json"):
         for p in sorted(glob.glob(os.path.join(ctx.root, pat))):
