@@ -1155,6 +1155,146 @@ def bench_serve_load():
     }
 
 
+def bench_mesh_chaos():
+    """ISSUE 17 acceptance: kill one host mid-wheel and prove the
+    elastic re-shard (parallel/elastic.run_elastic) resumes on the
+    survivors and certifies the SAME <= 1% gap as a fault-free
+    baseline.  A/B on the 8-virtual-device mesh split as 4 hosts x 2
+    devices: the A side spins a sharded fused wheel on a synthesized
+    farmer batch to the certified gap; the B side runs the identical
+    program under a FaultPlan that kills host 1 mid-wheel — membership
+    fences it, the MeshDegraded unwind lands the emergency checkpoint,
+    and run_elastic rebuilds at 6 devices (the batch re-pads with
+    zero-probability lanes) and resumes holding the bracket.  Gates:
+    mesh_reshards_lost_total carries an any-increase gate (0 resharded
+    runs lost) and reshard_reached_gap_frac a 1.0 ratchet MILESTONE
+    (telemetry/regress.py)."""
+    import tempfile
+
+    from mpisppy_tpu import scengen
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.cylinders.spoke import (
+        FusedLagrangianOuterBound, FusedXhatXbarInnerBound,
+    )
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    from mpisppy_tpu.parallel.elastic import run_elastic
+    from mpisppy_tpu.resilience import FaultPlan, MeshFault
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    from mpisppy_tpu.telemetry import EventBus
+    from mpisppy_tpu.telemetry import metrics as _metrics
+
+    S = 256 if SMOKE else (10_000 if QUICK else 100_000)
+    num_hosts = 4
+    kill_iter = 2 if SMOKE else 6
+    max_iters = 5 if SMOKE else MAX_WHEEL_ITERS
+    prog = farmer.scenario_program(S, seed=0)
+    wopts = fw.FusedWheelOptions(lag_windows=4, xhat_windows=2,
+                                 slam_windows=0, shuffle_windows=0,
+                                 split_dispatch=False,
+                                 lag_pdhg=pdhg.PDHGOptions(tol=1e-7),
+                                 xhat_pdhg=pdhg.PDHGOptions(
+                                     tol=1e-7, omega0=0.1,
+                                     restart_period=80))
+    spokes = [
+        {"spoke_class": FusedLagrangianOuterBound,
+         "opt_kwargs": {"options": {}}},
+        {"spoke_class": FusedXhatXbarInnerBound,
+         "opt_kwargs": {"options": {}}},
+    ]
+
+    def build_fn(ckpt):
+        def build(mesh):
+            b = mesh_mod.shard_batch(scengen.virtual_batch(prog), mesh,
+                                     pad=True)
+            opts = ph_mod.PHOptions(
+                default_rho=1.0, max_iterations=max_iters,
+                conv_thresh=0.0, subproblem_windows=10,
+                pdhg=pdhg.PDHGOptions(tol=1e-7,
+                                      iter_precision=ITER_PRECISION))
+            hub = {"hub_class": PHHub,
+                   "hub_kwargs": {"options": {
+                       "rel_gap": GAP_TARGET,
+                       "checkpoint_path": ckpt,
+                       "checkpoint_every_s": 1e9}},
+                   "opt_class": fw.FusedPH,
+                   "opt_kwargs": {"options": opts, "batch": b,
+                                  "wheel_options": wopts}}
+            return WheelSpinner(hub, spokes)
+        return build
+
+    def bracket(ws):
+        inner = float(ws.BestInnerBound)
+        outer = float(ws.BestOuterBound)
+        gap = (inner - outer) / max(abs(inner), abs(outer), 1e-12)
+        return inner, outer, gap
+
+    td = tempfile.mkdtemp(prefix="mesh_chaos_")
+
+    # A side: fault-free wheel at the full topology
+    t0 = time.perf_counter()
+    base = build_fn(os.path.join(td, "base.npz"))(mesh_mod.make_mesh())
+    base.spin()
+    base_s = round(time.perf_counter() - t0, 2)
+    ib, ob, gb = bracket(base)
+
+    # B side: identical program, host 1 dies at kill_iter
+    bus = EventBus()
+    lost0 = _metrics.REGISTRY.get("mesh_reshards_lost_total")
+    resh0 = _metrics.REGISTRY.get("mesh_reshards_total")
+    plan = FaultPlan(seed=11, meshes=(
+        MeshFault("host_lost", host=1, at_iters=(kill_iter,)),))
+    t1 = time.perf_counter()
+    ws, info = run_elastic(
+        build_fn(os.path.join(td, "chaos.npz")), num_hosts=num_hosts,
+        checkpoint_path=os.path.join(td, "chaos.npz"), plan=plan,
+        bus=bus, run_id="bench-mesh-chaos")
+    chaos_s = round(time.perf_counter() - t1, 2)
+    ic, oc, gc = bracket(ws)
+
+    lost = _metrics.REGISTRY.get("mesh_reshards_lost_total") - lost0
+    reshards = _metrics.REGISTRY.get("mesh_reshards_total") - resh0
+    certified = bool(gc <= GAP_TARGET)
+    return {
+        "scenarios": S,
+        "num_hosts": num_hosts,
+        "iter_precision": ITER_PRECISION or "bf16x6",
+        "gap_target": GAP_TARGET,
+        "baseline": {
+            "devices": 8, "inner": ib, "outer": ob,
+            "rel_gap": round(gb, 6), "iters": base.spcomm._iter,
+            "wall_s": base_s, "certified": bool(gb <= GAP_TARGET),
+        },
+        "chaos": {
+            "chaos": f"kill host 1 at hub iter {kill_iter}",
+            "final_devices": info["final_devices"],
+            "epoch": info["epoch"],
+            "inner": ic, "outer": oc, "rel_gap": round(gc, 6),
+            "iters": ws.spcomm._iter, "wall_s": chaos_s,
+            "certified": certified,
+            "reshard_transitions": info["reshards"],
+        },
+        "reshard": {
+            "mesh_reshards_total": reshards,
+            "mesh_reshards_lost_total": lost,
+            "reshard_reached_gap_frac": 1.0 if certified else 0.0,
+        },
+        "bench_mesh_chaos_total_sec": round(time.perf_counter() - t0, 1),
+        "note": "elastic mesh A/B: fault-free sharded fused wheel vs "
+                "the same wheel with host 1 killed mid-run; the "
+                "MeshDegraded unwind lands the emergency checkpoint, "
+                "run_elastic re-shards across the 6 survivor devices "
+                "(zero-probability pad lanes keep the bracket "
+                "layout-invariant) and the resumed run must certify "
+                "the same <= 1% gap; reshard_reached_gap_frac "
+                "ratchets at 1.0 and mesh_reshards_lost_total must "
+                "stay 0",
+    }
+
+
 def bench_fleet_serve_load():
     """ISSUE 16 acceptance: the replicated serve fleet under load with
     a replica killed mid-traffic (docs/serving.md fleet section).  A
@@ -1319,6 +1459,7 @@ _PHASES = {
     "wheel_scengen": lambda: bench_wheel_scengen(),
     "serve_load": lambda: bench_serve_load(),
     "fleet_serve_load": lambda: bench_fleet_serve_load(),
+    "mesh_chaos": lambda: bench_mesh_chaos(),
     "baseline_anchor": lambda: bench_baseline_anchor(),
 }
 
@@ -1383,6 +1524,15 @@ def _run_phase_subprocess(phase: str, timeout: int = 2400, retries: int = 1):
 
 def main():
     import sys
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase" \
+            and sys.argv[2] == "mesh_chaos":
+        # the elastic A/B needs a multi-host-shaped mesh: force 8
+        # virtual devices on the CPU backend (the flag only affects
+        # the host platform — harmless when a real accelerator runs)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
     _enable_compile_cache()
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         # child: run one phase, emit its JSON as the last stdout line

@@ -216,6 +216,15 @@ class AsyncFusedPH(fw.FusedPH):
             del self.plane_events[:-8]
         sid, spoke_iter = self._draw_spoke_cycle()
         plane = self._plane_slots[self._iter % 2]
+        if plane is not None \
+                and plane.W.shape[0] != self.wstate.ph.W.shape[0]:
+            # reshard-safe restore (ISSUE 17): an elastic re-shard
+            # restored a re-partitioned state whose scenario axis no
+            # longer matches the seeded slots — planes of the old
+            # layout are unreadable by the new device programs, so
+            # drop both slots and fall into the re-seed path below
+            plane = None
+            self._plane_slots = [None, None]
         if plane is None:
             # restored from a checkpoint: load_checkpoint skips
             # _iter0_impl, so re-seed both slots (and the delay line's

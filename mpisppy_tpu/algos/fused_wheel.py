@@ -693,7 +693,19 @@ class FusedPH(ph_mod.PH):
         else:
             scalars, xc, sc_, fc = inflight
         self._scalars_inflight = inflight
-        vals = np.asarray(scalars)
+        # the ONE place the hub loop blocks on the mesh: with an
+        # elastic MeshRuntime armed (parallel/elastic.py) the fetch is
+        # deadline-bounded and chaos-seamed — a straggler or lost host
+        # trips a typed MeshDegraded here instead of hanging the hub;
+        # without one, the plain fetch below is the whole cost
+        spcomm = getattr(self, "spcomm", None)
+        rt = None if spcomm is None \
+            else spcomm.options.get("mesh_runtime")
+        if rt is not None:
+            vals = rt.harvest(lambda: np.asarray(scalars),
+                              hub_iter=self._iter)
+        else:
+            vals = np.asarray(scalars)
         self.scalar_cache = dict(zip(SCALAR_KEYS, (float(v) for v in vals)))
         # device refs, transferred only when a spoke actually offers
         self.cand_cache = {"xhat": xc, "slam": sc_, "shuf": fc}

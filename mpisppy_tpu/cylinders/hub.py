@@ -23,6 +23,7 @@
 from __future__ import annotations
 
 import contextlib
+import functools
 import math
 import time
 
@@ -47,6 +48,57 @@ def _checkpoint_crc(data: dict) -> np.ndarray:
         arr = np.ascontiguousarray(data[k])
         crc = zlib.crc32(memoryview(arr).cast("B"), crc)
     return np.asarray(crc, np.uint32)
+
+
+def _identity(a):
+    return a
+
+
+@functools.lru_cache(maxsize=8)
+def _replicated_gather(mesh):
+    """Jitted identity with fully-replicated output on `mesh` — the
+    cross-host allgather that makes a scenario-sharded leaf fetchable
+    on every process (multi-process checkpointing, ISSUE 17).  Cached
+    per mesh so repeated saves reuse one executable."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(_identity,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def _fetch_leaf(x, timeout_s: float | None = None) -> np.ndarray:
+    """Fetch one state leaf to host.  Single-process (every shard
+    addressable) this is a plain np.asarray.  On a multi-process mesh a
+    scenario-sharded leaf spans NON-addressable devices, so it is first
+    replicated through a jitted identity collective — which every
+    process must enter (save_checkpoint runs at a deterministic
+    iteration cadence there, options['checkpoint_every_iters']).  The
+    gather is bounded by timeout_s: with a peer host dead the
+    collective never completes, and a last-gasp emergency save must
+    skip (and fall back to the last rotated snapshot) rather than hang
+    the survivor (docs/resilience.md failure-semantics table)."""
+    if getattr(x, "is_fully_addressable", True) \
+            or getattr(x, "is_fully_replicated", False):
+        return np.asarray(x)
+    gather = _replicated_gather(x.sharding.mesh)
+    if timeout_s is None:
+        return np.asarray(gather(x))
+    import threading
+    box: list = []
+
+    def run():
+        box.append(np.asarray(gather(x)))
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="mpisppy-tpu-ckpt-gather")
+    t.start()
+    t.join(float(timeout_s))
+    if not box:
+        raise TimeoutError(
+            f"cross-host checkpoint gather exceeded {timeout_s}s "
+            "(peer host unreachable?)")
+    return box[0]
 
 
 class Hub(SPCommunicator):
@@ -124,6 +176,7 @@ class Hub(SPCommunicator):
                 self, float(budget),
                 action=self.options.get("watchdog_action", "abort"),
                 interval_s=self.options.get("watchdog_interval_s"),
+                shrink_fn=self.options.get("watchdog_shrink_fn"),
             ).start()
         self._profiler = None
         if self.options.get("profile_dir"):
@@ -714,6 +767,19 @@ class PHHub(Hub):
         path = self.options.get("checkpoint_path")
         if not path:
             return
+        every_it = self.options.get("checkpoint_every_iters")
+        if every_it:
+            # deterministic iteration cadence, SYNCHRONOUS save: the
+            # multi-process mesh path (ISSUE 17).  The leaf fetch is a
+            # cross-host collective there, so every process must enter
+            # it at the same point in program order — wall-clock
+            # cadence and background writer threads both desync the
+            # collective streams and deadlock gloo.
+            if self._iter > 0 and self._iter % int(every_it) == 0 \
+                    and self._iter != getattr(self, "_last_ckpt_iter", -1):
+                if self.save_checkpoint(path):
+                    self._last_ckpt_iter = self._iter
+            return
         every = float(self.options.get("checkpoint_every_s", 60.0))
         now = _time.perf_counter()
         last = getattr(self, "_last_ckpt_t", None)
@@ -787,9 +853,20 @@ class PHHub(Hub):
         write lands after us its (older) snapshot becomes `path` and
         ours rotates to path.1 — load_checkpoint validates and falls
         back, so a complete snapshot survives either ordering.  Returns
-        True when a snapshot landed."""
-        return self.save_checkpoint(path, background=False,
-                                    tmp_tag=".emergency.tmp")
+        True when a snapshot landed.
+
+        Best effort BY CONTRACT: on a multi-process mesh whose peer
+        just died, the leaf-fetch gather cannot complete (bounded by
+        checkpoint_gather_timeout_s, _fetch_leaf) — the save is
+        reported skipped and the restore path falls back to the last
+        rotated periodic snapshot instead of hanging the survivor."""
+        try:
+            return self.save_checkpoint(path, background=False,
+                                        tmp_tag=".emergency.tmp")
+        except Exception as e:  # noqa: BLE001 — last-gasp, logged
+            global_toc(f"emergency checkpoint failed ({e}); "
+                       "falling back to last rotated snapshot", True)
+            return False
 
     def _checkpoint_meta(self, which: str) -> dict:
         """Host-side bookkeeping captured SYNCHRONOUSLY (the mutable
@@ -828,8 +905,9 @@ class PHHub(Hub):
         so load_checkpoint can reject silent corruption (a torn zip
         already fails np.load; bit rot inside a member does not)."""
         import os
+        gather_timeout = self.options.get("checkpoint_gather_timeout_s")
         for i, x in enumerate(leaves):
-            data[f"leaf{i}"] = np.asarray(x)
+            data[f"leaf{i}"] = _fetch_leaf(x, gather_timeout)
         data["crc"] = _checkpoint_crc(data)
         tmp = path + tmp_tag
         with open(tmp, "wb") as f:
@@ -858,6 +936,12 @@ class PHHub(Hub):
                     # losing a WRITE would matter
                     pass
             os.replace(tmp, path)
+            # durability: flush the directory inode so a host crash
+            # right after this rename cannot roll the entry back and
+            # lose the newest snapshot (utils/atomic_io.fsync_dir;
+            # tests/test_chaos.py crash-ordering test)
+            from mpisppy_tpu.utils.atomic_io import fsync_dir
+            fsync_dir(path)
         # may run on the background writer daemon: the bus is
         # thread-safe, and the snapshot's own hub_iter (not the
         # possibly-advanced live self._iter) stamps the event
@@ -881,10 +965,15 @@ class PHHub(Hub):
             i += 1
         return out
 
-    def load_checkpoint(self, path: str) -> dict:
+    def load_checkpoint(self, path: str, transform=None) -> dict:
         """Restore a save_checkpoint snapshot into the built (unspun)
         wheel; ph_main then skips Iter0 and resumes the loop.  Returns
         the extras dict.
+
+        transform: optional arrays-dict -> arrays-dict hook applied
+        after integrity checks and before shape validation — the
+        elastic-reshard seam (parallel/elastic.adapt_checkpoint_arrays
+        re-partitions scenario-major leaves onto a shrunk mesh).
 
         Falls back through the rotated candidates (path, path.1, ...)
         on a torn/corrupt/incompatible file instead of crashing — the
@@ -913,6 +1002,8 @@ class PHHub(Hub):
                 errors.append(f"{cand}: {type(e).__name__}: {e}")
                 continue
             try:
+                if transform is not None:
+                    arrays = transform(arrays)
                 extras = self._restore_from_arrays(arrays)
             except ValueError as e:  # wrong shapes/dtypes/leaf count
                 errors.append(f"{cand}: {e}")

@@ -1,0 +1,244 @@
+###############################################################################
+# Elastic mesh dry run worker: one PROCESS of a multi-process gloo mesh
+# under the ISSUE 17 fault domain.
+#
+#   python -m mpisppy_tpu.parallel._elastic_dryrun kill \
+#       <coordinator> <num_processes> <process_id> <devices_per_process> \
+#       <workdir>
+#   python -m mpisppy_tpu.parallel._elastic_dryrun partition \
+#       <coordinator> <num_processes> <process_id> <devices_per_process> \
+#       <workdir>
+#   python -m mpisppy_tpu.parallel._elastic_dryrun resume   <workdir>
+#   python -m mpisppy_tpu.parallel._elastic_dryrun baseline <workdir>
+#
+# kill: every process spins the SAME sharded fused wheel (SPMD) with a
+# synchronized periodic checkpoint every 4 hub iterations.  The victim
+# (last process) stops beaconing and dies at iter 5; the survivor's
+# beacon sweep goes SUSPECT, its bounded harvest trips MeshDegraded,
+# the emergency gather cannot complete without the dead peer (bounded
+# by checkpoint_gather_timeout_s, falls back to the iter-4 snapshot)
+# and the process exits 75 (EX_TEMPFAIL: restartable) printing
+# HOSTLOST.  gloo meshes cannot shrink live, so the elastic loop for
+# the multi-process fault domain is a RELAUNCH at the survivor
+# topology: `resume` runs single-process on 6 virtual devices (set
+# XLA_FLAGS in the environment), re-shards the S=13 program 16 -> 18
+# via elastic.adapt_checkpoint_arrays, and spins to the certified gap.
+# `baseline` is the fault-free A side at the full 8-device topology.
+#
+# partition: the victim's beacon delivery is suppressed for beats 1-2
+# (a network partition, not a death).  dead_after=3 means the survivor
+# only reaches SUSPECT; the first post-partition beat heals the host
+# and the wheel completes with NO reshard — suspicion alone never
+# re-shards (tests/test_multihost.py).
+###############################################################################
+import os
+import sys
+
+S = 13
+KILL_ITER = 5
+CKPT_EVERY = 4
+REL_GAP = 5e-3
+PARTITION_REL_GAP = 1e-3   # tighter target -> enough iters to heal
+
+
+def _build(mesh, ckpt, rel_gap, extra=None):
+    from mpisppy_tpu import scengen
+    from mpisppy_tpu.algos import fused_wheel as fw
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.cylinders.spoke import (
+        FusedLagrangianOuterBound, FusedXhatXbarInnerBound,
+    )
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.ops import pdhg
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    prog = farmer.scenario_program(S, seed=0)
+    b = mesh_mod.shard_batch(scengen.virtual_batch(prog), mesh, pad=True)
+    opts = ph_mod.PHOptions(default_rho=1.0, max_iterations=80,
+                            conv_thresh=0.0, subproblem_windows=10,
+                            pdhg=pdhg.PDHGOptions(tol=1e-7))
+    wopts = fw.FusedWheelOptions(lag_windows=4, xhat_windows=2,
+                                 slam_windows=0, shuffle_windows=0,
+                                 split_dispatch=False,
+                                 lag_pdhg=pdhg.PDHGOptions(tol=1e-7),
+                                 xhat_pdhg=pdhg.PDHGOptions(
+                                     tol=1e-7, omega0=0.1,
+                                     restart_period=80))
+    hub_opts = {"rel_gap": rel_gap, "checkpoint_path": ckpt,
+                "checkpoint_every_s": 1e9}
+    hub_opts.update(extra or {})
+    hub = {"hub_class": PHHub, "hub_kwargs": {"options": hub_opts},
+           "opt_class": fw.FusedPH,
+           "opt_kwargs": {"options": opts, "batch": b,
+                          "wheel_options": wopts}}
+    spokes = [
+        {"spoke_class": FusedLagrangianOuterBound,
+         "opt_kwargs": {"options": {}}},
+        {"spoke_class": FusedXhatXbarInnerBound,
+         "opt_kwargs": {"options": {}}},
+    ]
+    return WheelSpinner(hub, spokes)
+
+
+def _bracket(ws):
+    inner, outer = float(ws.BestInnerBound), float(ws.BestOuterBound)
+    gap = (inner - outer) / max(abs(inner), abs(outer), 1e-12)
+    return inner, outer, gap
+
+
+class _ArmedRuntime:
+    """MeshRuntime whose harvest deadline only arms once the compiled
+    programs are warm (iters 0-1 pay XLA compile inside the fetch, so
+    a fixed deadline would trip a false straggler on a cold cache)."""
+
+    def __init__(self, rt, arm_after=2, deadline_s=20.0):
+        self._rt, self._arm, self._dl = rt, arm_after, deadline_s
+
+    def harvest(self, fetch, hub_iter):
+        self._rt.deadline_s = self._dl if hub_iter >= self._arm else None
+        return self._rt.harvest(fetch, hub_iter)
+
+
+class _Victim:
+    """The doomed host's harvest seam: beacons while healthy, falls
+    silent one iteration before dying so the survivor's sweep sees the
+    staleness, then exits without warning (a real host loss)."""
+
+    def __init__(self, membership, self_host):
+        self._mm, self._host = membership, self_host
+
+    def harvest(self, fetch, hub_iter):
+        import numpy as np
+        if hub_iter >= KILL_ITER:
+            sys.stdout.flush()
+            os._exit(0)
+        if hub_iter < KILL_ITER - 1:
+            self._mm.beat(self._host)
+        return np.asarray(fetch())
+
+
+def _run_kill(coord, n_proc, pid, dev_per, workdir):
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    mesh_mod.init_multihost(coord, n_proc, pid,
+                            cpu_devices_per_process=dev_per)
+    from mpisppy_tpu.parallel import elastic
+
+    beacons = os.path.join(workdir, "beacons")
+    os.makedirs(beacons, exist_ok=True)
+    ckpt = os.path.join(workdir, f"ckpt_p{pid}.npz")
+    ws = _build(mesh_mod.make_mesh(), ckpt, REL_GAP,
+                extra={"checkpoint_every_iters": CKPT_EVERY,
+                       "checkpoint_gather_timeout_s": 5.0})
+    ws.build()
+    mm = elastic.MeshMembership(n_proc, dead_after=2, self_host=pid,
+                                beacon_dir=beacons)
+    victim = pid == n_proc - 1
+    if victim:
+        ws.spcomm.options["mesh_runtime"] = _Victim(mm, pid)
+    else:
+        rt = elastic.MeshRuntime(mm)
+        ws.spcomm.options["mesh_runtime"] = _ArmedRuntime(rt)
+    try:
+        ws.spin()
+    except elastic.MeshDegraded as e:
+        # confirm the death on the beacon ladder (the bounded harvest
+        # tripped first; the sweep is what names the lost host)
+        for _ in range(3):
+            mm.poll()
+        print(f"HOSTLOST reason={e.reason} "  # telemetry: allow-print
+              f"iter={e.hub_iter} "
+              f"dead={mm.dead_hosts()} "
+              f"ckpt={int(os.path.exists(ckpt))}", flush=True)
+        os._exit(75)
+    print(f"UNEXPECTED_COMPLETE "  # telemetry: allow-print
+          f"iter={ws.spcomm._iter}", flush=True)
+    os._exit(1)
+
+
+def _run_partition(coord, n_proc, pid, dev_per, workdir):
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    mesh_mod.init_multihost(coord, n_proc, pid,
+                            cpu_devices_per_process=dev_per)
+    from mpisppy_tpu.parallel import elastic
+    from mpisppy_tpu.resilience import FaultPlan, MeshFault
+    from mpisppy_tpu.telemetry import EventBus
+
+    beacons = os.path.join(workdir, "beacons")
+    os.makedirs(beacons, exist_ok=True)
+    ckpt = os.path.join(workdir, f"ckpt_p{pid}.npz")
+    ws = _build(mesh_mod.make_mesh(), ckpt, PARTITION_REL_GAP)
+    ws.build()
+
+    moves: list[str] = []   # membership transition history, in order
+
+    class _Sink:
+        def handle(self, event):
+            if event.kind == "mesh-state":
+                moves.append(f"{event.data['host']}:"
+                             f"{event.data['state']}")
+
+    bus = EventBus()
+    bus.subscribe(_Sink())
+    mm = elastic.MeshMembership(n_proc, dead_after=3, self_host=pid,
+                                beacon_dir=beacons, bus=bus,
+                                run=f"p{pid}")
+    victim = pid == n_proc - 1
+    plan = FaultPlan(meshes=(
+        MeshFault("partition", host=pid, at_beats=(1, 2)),)) \
+        if victim else None
+    rt = elastic.MeshRuntime(mm, plan=plan)
+    ws.spcomm.options["mesh_runtime"] = rt
+    ws.spin()
+    inner, outer, gap = _bracket(ws)
+    print(f"PARTITION_OK "  # telemetry: allow-print
+          f"inner={inner:.6e} outer={outer:.6e} "
+          f"gap={gap:.3e} iter={ws.spcomm._iter} "
+          f"moves={','.join(moves) or 'none'} "
+          f"dead={mm.dead_hosts()} epoch={mm.epoch}", flush=True)
+
+
+def _run_single(workdir, resume):
+    import jax
+
+    from mpisppy_tpu.parallel import elastic, mesh as mesh_mod
+
+    n_dev = jax.device_count()
+    tag = "RESUME" if resume else "BASE"
+    ckpt = os.path.join(
+        workdir, "ckpt_p0.npz" if resume else "ckpt_base.npz")
+    ws = _build(mesh_mod.make_mesh(), ckpt, REL_GAP)
+    ws.build()
+    start = 0
+    if resume:
+        s_old = 16              # S=13 padded on the full 8-device mesh
+        s_new = ws.spcomm.opt.batch.num_scenarios
+        ws.spcomm.load_checkpoint(
+            ckpt, transform=lambda arrays: elastic.adapt_checkpoint_arrays(
+                arrays, S, s_old, s_new))
+        start = ws.spcomm._iter
+    ws.spin()
+    inner, outer, gap = _bracket(ws)
+    print(f"{tag} "  # telemetry: allow-print
+          f"inner={inner:.6e} outer={outer:.6e} gap={gap:.3e} "
+          f"start={start} iter={ws.spcomm._iter} devices={n_dev}",
+          flush=True)
+
+
+def main():
+    mode = sys.argv[1]
+    if mode in ("kill", "partition"):
+        coord, n_proc, pid, dev_per, workdir = sys.argv[2:7]
+        fn = _run_kill if mode == "kill" else _run_partition
+        fn(coord, int(n_proc), int(pid), int(dev_per), workdir)
+    elif mode == "resume":
+        _run_single(sys.argv[2], resume=True)
+    elif mode == "baseline":
+        _run_single(sys.argv[2], resume=False)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,18 +99,34 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, pad: bool = False):
     """Place a ScenarioBatch on the mesh: scenario-major arrays sharded on
     their leading axis, shared arrays replicated.  Scenario-carrying
     fields are recognized by leading-axis length == num_scenarios with the
-    field's batched rank (mirrors pad_to_multiple's ndim logic)."""
+    field's batched rank (mirrors pad_to_multiple's ndim logic).
+
+    pad=True re-pads the scenario axis to the mesh's multiple first —
+    the elastic-reshard path onto a SURVIVOR set whose device count
+    does not divide S (docs/resilience.md).  Padding lanes carry ZERO
+    probability mass (never a replicated real lane's probability), so
+    every p-weighted reduction — eobjective, conv, the certified
+    bounds — is value-identical to the pre-loss layout."""
     S = batch.num_scenarios
     if S % mesh.size != 0:
-        raise ValueError(
-            f"{S} scenarios not divisible by mesh size {mesh.size}; "
-            "use core.batch.pad_to_multiple first"
-            + (" (scengen: virtual_batch(pad_to=mesh.size))"
-               if getattr(batch, "is_virtual", False) else ""))
+        if pad:
+            if getattr(batch, "is_virtual", False):
+                from mpisppy_tpu.scengen.virtual import repartition
+                batch = repartition(batch, mesh.size)
+            else:
+                from mpisppy_tpu.core.batch import pad_to_multiple
+                batch = pad_to_multiple(batch, mesh.size)
+            S = batch.num_scenarios
+        else:
+            raise ValueError(
+                f"{S} scenarios not divisible by mesh size {mesh.size}; "
+                "use core.batch.pad_to_multiple first"
+                + (" (scengen: virtual_batch(pad_to=mesh.size))"
+                   if getattr(batch, "is_virtual", False) else ""))
     shard = scen_sharding(mesh)
     repl = replicated(mesh)
 

@@ -1,6 +1,6 @@
 from mpisppy_tpu.resilience.faults import (  # noqa: F401
     CheckpointFault, DispatchFault, DispatchPoison, FaultPlan, LaneFault,
-    PreemptionError, ReplicaFault, ServeFault, SimulatedPreemption,
-    SpokeBoundFault,
+    MeshFault, PreemptionError, ReplicaFault, ServeFault,
+    SimulatedPreemption, SpokeBoundFault,
 )
 from mpisppy_tpu.resilience.watchdog import HubWatchdog  # noqa: F401

@@ -267,6 +267,52 @@ class ReplicaFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class MeshFault:
+    """One mesh-layer fault (ISSUE 17; docs/resilience.md): the
+    host-only seams of the elastic mesh fault domain
+    (mpisppy_tpu/parallel/elastic.py).
+
+    kind: 'host_lost'    -> the named host drops out of the mesh at
+                            hub iteration at_iters[0] (fires once):
+                            membership marks it DEAD, the elastic
+                            runner emergency-checkpoints the hub
+                            plane and re-shards the wheel across the
+                            survivors
+          'partition'    -> the host's heartbeat beacons are
+                            suppressed while the beat index is inside
+                            the at_beats window; shorter than the
+                            DEAD budget the host turns SUSPECT and
+                            rejoins UP at the next epoch WITHOUT a
+                            reshard (the partition-heals case)
+          'straggler'    -> the hub-harvest device fetch is delayed
+                            delay_s seconds at each of at_iters (a
+                            slow collective; pushed past the harvest
+                            deadline this trips a typed MeshDegraded,
+                            never a hang)
+          'torn_harvest' -> the harvested scalar vector is corrupted
+                            to NaN at each of at_iters (fires once
+                            per iteration): the caller must detect
+                            the tear and synchronously re-fetch — the
+                            device value is intact, only the transfer
+                            tore
+
+    host: which host index the fault names (host_lost/partition);
+    at_iters: hub iterations (host_lost fires once at the first);
+    at_beats: suppressed heartbeat window for 'partition'."""
+
+    kind: str
+    host: int = 1
+    at_iters: tuple[int, ...] = ()
+    at_beats: tuple[int, ...] = ()
+    delay_s: float = 0.05
+
+    def __post_init__(self):
+        if self.kind not in ("host_lost", "partition", "straggler",
+                             "torn_harvest"):
+            raise ValueError(f"unknown mesh fault {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class CheckpointFault:
     """Damage the `at_write`-th completed checkpoint file (0-based).
 
@@ -294,7 +340,8 @@ class FaultPlan:
 
     def __init__(self, seed: int = 0, spoke_bounds=(), lanes=(),
                  checkpoints=(), preempt_at_iter: int | None = None,
-                 dispatches=(), exchanges=(), serves=(), replicas=()):
+                 dispatches=(), exchanges=(), serves=(), replicas=(),
+                 meshes=()):
         self.rng = np.random.default_rng(seed)
         self.spoke_bounds = tuple(spoke_bounds)
         self.lanes = tuple(lanes)
@@ -304,6 +351,7 @@ class FaultPlan:
         self.exchanges = tuple(exchanges)
         self.serves = tuple(serves)
         self.replicas = tuple(replicas)
+        self.meshes = tuple(meshes)
         self.fired: list[tuple[str, str]] = []
         self._writes = 0
         self._first_seen: dict[int, float] = {}
@@ -314,6 +362,10 @@ class FaultPlan:
         self._killed_replicas: set[str] = set()
         self._partitions_fired: set[tuple[str, int]] = set()
         self._slow_replicas: set[str] = set()
+        self._lost_hosts: set[int] = set()
+        self._mesh_partitions_fired: set[tuple[int, int]] = set()
+        self._torn_harvests: set[int] = set()
+        self._stragglers_fired: set[tuple[int, int]] = set()
         # set by the hub when the plan is armed in its options: every
         # injection also lands in the telemetry stream as a
         # fault-injected event (docs/telemetry.md), so a chaos run's
@@ -339,7 +391,7 @@ class FaultPlan:
     def armed(self) -> bool:
         return bool(self.spoke_bounds or self.lanes or self.checkpoints
                     or self.dispatches or self.exchanges or self.serves
-                    or self.replicas
+                    or self.replicas or self.meshes
                     or self.preempt_at_iter is not None)
 
     # -- seams: serve layer (mpisppy_tpu/serve; docs/serving.md) ----------
@@ -429,6 +481,71 @@ class FaultPlan:
             self._fire("replica",
                        f"slow-heartbeat {rid} +{f.delay_s}s")
         return float(f.delay_s)
+
+    # -- seams: elastic mesh (parallel/elastic.py; docs/resilience.md) ----
+    def _mesh_hits(self, kind: str):
+        return [f for f in self.meshes if f.kind == kind]
+
+    def mesh_lost_host(self, hub_iter: int) -> int | None:
+        """Host index that drops out of the mesh NOW, or None.  Fires
+        once per host, at the first armed hub iteration reached."""
+        self.telemetry_iter = hub_iter
+        for f in self._mesh_hits("host_lost"):
+            if f.host in self._lost_hosts:
+                continue
+            first = f.at_iters[0] if f.at_iters else 0
+            if hub_iter < first:
+                continue
+            self._lost_hosts.add(f.host)
+            self._fire("mesh", f"host_lost host{f.host} iter{hub_iter}")
+            return f.host
+        return None
+
+    def mesh_partitioned(self, host: int, beat: int) -> bool:
+        """True while the host's heartbeat beacons must be suppressed
+        (the DCN partition window)."""
+        for f in self._mesh_hits("partition"):
+            if f.host != host or beat not in f.at_beats:
+                continue
+            if (host, beat) not in self._mesh_partitions_fired:
+                self._mesh_partitions_fired.add((host, beat))
+                self._fire("mesh", f"partition host{host}@beat{beat}")
+            return True
+        return False
+
+    def mesh_harvest_delay(self, hub_iter: int) -> float:
+        """Extra seconds the hub-harvest fetch must sleep this
+        iteration (the straggler collective); 0.0 unarmed."""
+        self.telemetry_iter = hub_iter
+        delay = 0.0
+        for i, f in enumerate(self._mesh_hits("straggler")):
+            if f.at_iters and hub_iter not in f.at_iters:
+                continue
+            if (i, hub_iter) in self._stragglers_fired:
+                # fires once per (fault, iteration): a resumed run that
+                # re-executes the trip iteration must not re-straggle —
+                # the injected collective was transiently slow, not
+                # permanently wedged (a re-trip would livelock the
+                # elastic runner into its max_reshards budget)
+                continue
+            self._stragglers_fired.add((i, hub_iter))
+            self._fire("mesh", f"straggler +{f.delay_s}s iter{hub_iter}")
+            delay += float(f.delay_s)
+        return delay
+
+    def mesh_torn_harvest(self, hub_iter: int) -> bool:
+        """True when the fetched scalar vector must be torn (NaN) this
+        iteration; fires once per iteration."""
+        self.telemetry_iter = hub_iter
+        for f in self._mesh_hits("torn_harvest"):
+            if f.at_iters and hub_iter not in f.at_iters:
+                continue
+            if hub_iter in self._torn_harvests:
+                return False
+            self._torn_harvests.add(hub_iter)
+            self._fire("mesh", f"torn_harvest iter{hub_iter}")
+            return True
+        return False
 
     # -- seams: async exchange (async_wheel.AsyncFusedPH / AsyncPHHub) ----
     def filter_plane_write(self, hub_iter: int, new_plane, old_plane):

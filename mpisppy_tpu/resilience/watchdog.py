@@ -50,8 +50,9 @@ class HubWatchdog:
     (default os._exit — a hung process cannot be unwound politely)."""
 
     def __init__(self, hub, budget_s: float, action: str = "abort",
-                 interval_s: float | None = None, abort_fn=None):
-        if action not in ("abort", "degrade"):
+                 interval_s: float | None = None, abort_fn=None,
+                 shrink_fn=None):
+        if action not in ("abort", "degrade", "shrink"):
             raise ValueError(f"unknown watchdog action {action!r}")
         self.hub = hub
         self.budget_s = float(budget_s)
@@ -59,12 +60,20 @@ class HubWatchdog:
         self.interval_s = max(0.01, float(interval_s)) \
             if interval_s is not None else max(0.05, self.budget_s / 4.0)
         self.abort_fn = abort_fn or os._exit
+        # shrink_fn: the elastic-mesh escalation rung (ISSUE 17) —
+        # called once between degrade and abort when action='shrink';
+        # returns True when the wheel was re-homed onto a smaller
+        # survivor mesh (parallel/elastic.py supplies it).  A missing
+        # or failing shrink falls through to abort on the next trip.
+        self.shrink_fn = shrink_fn
         # trips/degraded are touched only on the supervisor thread
         # (and read by tests after stop()); the beat path shares only
         # the two _lock-guarded fields below (lint-enforced:
         # tools/graftlint lock-discipline)
         self.trips = 0
         self.degraded = False
+        self.shrunk = False
+        self._shrink_attempted = False
         self._lock = threading.Lock()
         self._last_progress = time.perf_counter()  # guarded-by: _lock
         self._last = (None, None, None)            # guarded-by: _lock
@@ -121,10 +130,22 @@ class HubWatchdog:
         if self._stop.is_set():
             return
         self.trips += 1
-        escalate = self.action == "abort" \
-            or (self.action == "degrade" and self.degraded)
-        action = "abort" if escalate else "degrade"
-        self._emit(action=action, stalled_s=round(stalled, 3),
+        # escalation ladder per configured action (PR-8 semantics,
+        # extended with the elastic rung): 'abort' goes straight there;
+        # 'degrade' gives one degraded budget first; 'shrink' walks
+        # degrade -> shrink (re-home onto the survivor mesh) -> abort,
+        # each rung consuming one full stall budget
+        if self.action == "abort":
+            rung = "abort"
+        elif self.action == "degrade":
+            rung = "abort" if self.degraded else "degrade"
+        elif not self.degraded:
+            rung = "degrade"
+        elif not self._shrink_attempted and self.shrink_fn is not None:
+            rung = "shrink"
+        else:
+            rung = "abort"
+        self._emit(action=rung, stalled_s=round(stalled, 3),
                    budget_s=self.budget_s, trips=self.trips)
         try:
             from mpisppy_tpu.telemetry import metrics as _metrics
@@ -132,8 +153,10 @@ class HubWatchdog:
         except Exception:
             pass
         self._dump_flight(stalled)
-        if escalate:
+        if rung == "abort":
             self._abort(stalled)
+        elif rung == "shrink":
+            self._shrink(stalled)
         else:
             self._degrade()
 
@@ -174,6 +197,26 @@ class HubWatchdog:
             from mpisppy_tpu.telemetry import console as _console
             _console.log("watchdog: hub stalled past budget — degraded "
                          "dispatch to direct un-coalesced mode")
+        except Exception:
+            pass
+
+    def _shrink(self, stalled: float) -> None:
+        """The elastic rung: ask parallel/elastic.py to emergency-
+        checkpoint and re-home the wheel onto the surviving mesh.  A
+        shrink that fails (or returns False) leaves `shrunk` unset so
+        the NEXT trip escalates to abort — the ladder never wedges."""
+        self._shrink_attempted = True
+        try:
+            self.shrunk = bool(self.shrink_fn(stalled))
+        except Exception:
+            self.shrunk = False
+        try:
+            from mpisppy_tpu.telemetry import console as _console
+            _console.log(
+                "watchdog: hub stalled past degraded budget — "
+                + ("re-homed the wheel onto the survivor mesh"
+                   if self.shrunk else
+                   "shrink failed; next trip aborts (exit 75)"))
         except Exception:
             pass
 

@@ -275,6 +275,36 @@ def virtual_batch(program: ScenarioProgram, pad_to: int | None = None,
     return vb
 
 
+def repartition(vb: VirtualBatch, pad_to: int) -> VirtualBatch:
+    """Re-derive the scenario-axis layout for a new device count — the
+    elastic-reshard primitive (docs/resilience.md, docs/scengen.md
+    reshard-invariance contract).
+
+    Scenario data never moves: it is synthesized from fold_in(base_key,
+    scenario_index), and the index range [start, start + num_real) is a
+    property of the PROGRAM, not of the mesh layout.  Only the O(S)
+    probability vector and the multistage node map carry the padded
+    scenario axis, so re-sharding after a host loss rebuilds exactly
+    those two: real probabilities keep their values, pad rows get
+    probability ZERO (never a cloned real probability — every
+    p-weighted reduction stays value-identical across layouts), and
+    realize()'s index clamp makes the pad rows clone the last real
+    scenario's data as before."""
+    S = vb.num_real
+    S_p = S + ((-S) % int(pad_to))
+    p_real = np.asarray(vb.p)[:S]
+    probs = np.zeros(S_p, p_real.dtype)
+    probs[:S] = p_real
+    nos = vb.node_of_slot
+    if nos is not None:
+        nos_np = np.asarray(nos)[:S]
+        if S_p > S:
+            nos_np = np.concatenate(
+                [nos_np, np.repeat(nos_np[-1:], S_p - S, axis=0)], axis=0)
+        nos = jnp.asarray(nos_np)
+    return dataclasses.replace(vb, p=jnp.asarray(probs), node_of_slot=nos)
+
+
 def materialize(program: ScenarioProgram) -> ScenarioBatch:
     """Device-synthesize the WHOLE batch in one jitted realize — the
     bit-identity counterpart of from_specs(program.to_specs(),

@@ -32,7 +32,8 @@ from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     CHECKPOINT_RESTORE, CHECKPOINT_WRITE, CONSOLE, DISPATCH,
     DISPATCH_QUARANTINE, DISPATCH_RETRY, EXCHANGE_OVERLAP,
     FAULT_INJECTED, FLEET_PLACEMENT, HUB_ITERATION, KERNEL_COUNTERS,
-    LANE_QUARANTINE, PLANE_WRITE, PROFILE, REPLICA_STATE, RUN_END,
+    LANE_QUARANTINE, MESH_HOST_LOST, MESH_RESHARD, MESH_STATE,
+    MESH_STRAGGLER, PLANE_WRITE, PROFILE, REPLICA_STATE, RUN_END,
     RUN_START, SESSION_MIGRATED, SESSION_STATE, SPAN,
     SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG, Event,
     new_run_id,

@@ -108,6 +108,10 @@ class RunModel:
     overlaps: list = dataclasses.field(default_factory=list)  # async rows
     placements: list = dataclasses.field(default_factory=list)  # fleet
     migrations: list = dataclasses.field(default_factory=list)  # fleet
+    mesh_states: list = dataclasses.field(default_factory=list)  # elastic
+    mesh_losses: list = dataclasses.field(default_factory=list)  # elastic
+    mesh_reshards: list = dataclasses.field(default_factory=list)
+    mesh_stragglers: list = dataclasses.field(default_factory=list)
 
     def iter_of(self, it: int) -> HubIter:
         if it not in self.iters:
@@ -228,6 +232,14 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
             m.placements.append({"iter": it, **data})
         elif kind == ev.SESSION_MIGRATED:
             m.migrations.append({"iter": it, **data})
+        elif kind == ev.MESH_STATE:
+            m.mesh_states.append({"iter": it, **data})
+        elif kind == ev.MESH_HOST_LOST:
+            m.mesh_losses.append({"iter": it, **data})
+        elif kind == ev.MESH_RESHARD:
+            m.mesh_reshards.append({"iter": it, **data})
+        elif kind == ev.MESH_STRAGGLER:
+            m.mesh_stragglers.append({"iter": it, **data})
     return m
 
 
@@ -478,6 +490,32 @@ def _fleet_summary(model: RunModel) -> dict | None:
     }
 
 
+def _mesh_summary(model: RunModel) -> dict | None:
+    """Elastic-mesh rows (ISSUE 17): membership churn, host losses,
+    reshards, and harvest degradations.  None when no mesh fault-domain
+    events rode the trace (every pre-elastic run)."""
+    if not (model.mesh_states or model.mesh_losses
+            or model.mesh_reshards or model.mesh_stragglers):
+        return None
+    return {
+        "transitions": len(model.mesh_states),
+        "final_epoch": max(
+            [int(s.get("epoch", 0)) for s in model.mesh_states],
+            default=0),
+        "hosts_lost": sorted({loss.get("host")
+                              for loss in model.mesh_losses
+                              if loss.get("host") is not None}),
+        "reshards": [{"hub_iter": rs.get("hub_iter"),
+                      "old_devices": rs.get("old_devices"),
+                      "new_devices": rs.get("new_devices")}
+                     for rs in model.mesh_reshards],
+        "stragglers": sum(1 for s in model.mesh_stragglers
+                          if s.get("mode") == "deadline"),
+        "torn_harvests": sum(1 for s in model.mesh_stragglers
+                             if s.get("mode") == "torn"),
+    }
+
+
 def _async_wheel(model: RunModel) -> dict | None:
     """Plane-staleness + host/device overlap attribution for an async
     wheel run (ISSUE 11): how stale the exchange plane actually ran,
@@ -556,6 +594,7 @@ def analyze(model: RunModel) -> dict:
         "kernel": model.kernel,
         "async_wheel": _async_wheel(model),
         "fleet": _fleet_summary(model),
+        "mesh": _mesh_summary(model),
     }
     flags = []
     stall = bounds.get("iters_since_outer_moved")
@@ -752,6 +791,19 @@ def render_report(rep: dict) -> str:
                     if fl["replica_chain"] else "")
                  + (f"  at iters {fl['migrated_at_iters']}"
                     if fl["migrated_at_iters"] else ""))
+    msh = rep.get("mesh")
+    if msh:
+        L.append(f"mesh: epoch {msh['final_epoch']}  "
+                 f"hosts lost {msh['hosts_lost'] or '[]'}  "
+                 f"reshards {len(msh['reshards'])}"
+                 + ("".join(f"  [{r['old_devices']}->"
+                            f"{r['new_devices']}dev@iter"
+                            f"{r['hub_iter']}]"
+                            for r in msh["reshards"]))
+                 + (f"  stragglers {msh['stragglers']}"
+                    if msh["stragglers"] else "")
+                 + (f"  torn harvests {msh['torn_harvests']}"
+                    if msh["torn_harvests"] else ""))
     res = rep["resilience"]
     if any(v for v in res.values()):
         L.append(f"resilience: faults {res['faults_injected'] or '{}'}  "

@@ -63,6 +63,21 @@ SESSION_MIGRATED = "session-migrated"  # fleet router: a session moved
                                        # destination; non-terminal)
 REPLICA_STATE = "replica-state"        # fleet health plane: a replica
                                        # moved UP/SUSPECT/DEAD/DRAINED
+MESH_STATE = "mesh-state"              # elastic mesh membership: a host
+                                       # moved UP/SUSPECT/DEAD, with the
+                                       # epoch that observed the move
+                                       # (parallel/elastic.py)
+MESH_HOST_LOST = "mesh-host-lost"      # elastic mesh: a host went
+                                       # sticky-DEAD and its shard is
+                                       # orphaned — a reshard follows
+MESH_RESHARD = "mesh-reshard"          # elastic mesh: the wheel was
+                                       # re-partitioned across the
+                                       # survivor set (old/new device
+                                       # counts, epoch, hub_iter)
+MESH_STRAGGLER = "mesh-straggler"      # elastic mesh: a hub-harvest
+                                       # fetch missed its deadline or
+                                       # tore; typed MeshDegraded (or a
+                                       # clean re-fetch), never a hang
 SCENGEN = "scengen"                    # a VirtualBatch was built: the
                                        # program, scenario count, base
                                        # seed, and the resident-vs-

@@ -87,6 +87,14 @@ ALL_METRICS = frozenset({
     "fleet_migrations_lost_total",
     "fleet_placement_affinity_total",
     "fleet_placement_spill_total",
+    # elastic mesh fault domain (parallel/elastic.py; ISSUE 17)
+    "mesh_hosts_up",
+    "mesh_epoch",
+    "mesh_hosts_lost_total",
+    "mesh_reshards_total",
+    "mesh_reshards_lost_total",
+    "mesh_stragglers_total",
+    "mesh_torn_harvests_total",
 })
 
 

@@ -89,6 +89,10 @@ GATES: tuple[tuple[str, str, float], ...] = (
     # patterns above unchanged (the phase is named fleet_serve_load,
     # and the gates' searches are unanchored).
     (r"migrations_lost", "up", 0.0),
+    # elastic mesh (ISSUE 17; BENCH mesh_chaos phase): a re-shard that
+    # loses its run is ALWAYS a regression — the counter stays 0.
+    # watchdog_trips rides the any-increase gate above unchanged.
+    (r"mesh_reshards_lost", "up", 0.0),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -140,6 +144,12 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # story is fiction
     (r"fleet_serve_load\.migration\.migrated_reached_gap_frac$",
      "down", 1.0),
+    # elastic mesh (ISSUE 17 acceptance; docs/resilience.md): a run
+    # that survives a mid-wheel host loss must re-shard across the
+    # survivors and STILL certify the same gap target as the
+    # fault-free baseline — anything under 1.0 means a reshard lost
+    # certified progress
+    (r"mesh_chaos\..*reshard_reached_gap_frac$", "down", 1.0),
 )
 
 

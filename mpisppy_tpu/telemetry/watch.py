@@ -54,6 +54,11 @@ class WatchState:
         self.dispatch_retries = 0
         self.dispatch_quarantined = 0
         self.watchdog_trips = 0
+        self.mesh_epoch = 0         # elastic mesh (ISSUE 17)
+        self.mesh_hosts_lost = 0
+        self.mesh_reshards = 0
+        self.mesh_devices = None    # old->new of the latest reshard
+        self.mesh_stragglers = 0
         self.ckpt_writes = 0
         self.last_ckpt_wall = None
         self.last_event_wall = None
@@ -129,6 +134,17 @@ class WatchState:
             self.tenant = data.get("tenant", self.tenant)
             self.migrations = max(self.migrations,
                                   data.get("migrations", 0) or 0)
+        elif kind == "mesh-state":
+            self.mesh_epoch = max(self.mesh_epoch,
+                                  data.get("epoch", 0) or 0)
+        elif kind == "mesh-host-lost":
+            self.mesh_hosts_lost += 1
+        elif kind == "mesh-reshard":
+            self.mesh_reshards += 1
+            self.mesh_devices = (f"{data.get('old_devices')}->"
+                                 f"{data.get('new_devices')}")
+        elif kind == "mesh-straggler":
+            self.mesh_stragglers += 1
         elif kind == "profile":
             self.profile_dir = data.get("profile_dir", self.profile_dir)
 
@@ -226,6 +242,15 @@ def render_status(state: WatchState,
              f"  ckpt writes {state.ckpt_writes}"
              + (f" (last {ck_age:.0f}s ago)" if ck_age is not None
                 else ""))
+    if (state.mesh_epoch or state.mesh_hosts_lost
+            or state.mesh_reshards or state.mesh_stragglers):
+        L.append(f"mesh: epoch {state.mesh_epoch}"
+                 f"  hosts lost {state.mesh_hosts_lost}"
+                 f"  reshards {state.mesh_reshards}"
+                 + (f" ({state.mesh_devices} devices)"
+                    if state.mesh_devices else "")
+                 + (f"  stragglers/tears {state.mesh_stragglers}"
+                    if state.mesh_stragglers else ""))
     if metrics:
         keys = sorted(k for k in metrics
                       if k.startswith(("dispatch_", "wheel_", "pdhg_")))

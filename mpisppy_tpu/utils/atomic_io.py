@@ -23,6 +23,31 @@ def atomic_write_text(path: str, text: str) -> None:
     atomic_write_bytes(path, text.encode())
 
 
+def fsync_dir(path: str) -> None:
+    """fsync the DIRECTORY holding `path` (or the directory itself).
+
+    os.replace makes a rename atomic but not durable: until the
+    directory inode is flushed, a crash can roll the directory entry
+    back to the pre-rename state — for the checkpoint spool that means
+    losing the newest-snapshot pointer even though its bytes fully
+    landed.  Callers invoke this after the rename(s) that must survive
+    a host loss (cylinders/hub._write_checkpoint rotation).  Platforms
+    whose directory handles refuse fsync (some network filesystems,
+    Windows) degrade to the old non-durable behavior rather than
+    failing the write."""
+    d = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def append_text(path: str, text: str) -> None:
     """Append one block in a single os.write on an O_APPEND descriptor:
     concurrent appenders never interleave mid-block, and a crash can

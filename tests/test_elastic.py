@@ -1,0 +1,469 @@
+# Elastic mesh fault domain (ISSUE 17; mpisppy_tpu/parallel/elastic.py,
+# docs/resilience.md): host membership ladder (UP -> SUSPECT -> sticky
+# DEAD with epochs), the MeshFault chaos seams, the bounded hub
+# harvest (typed MeshDegraded, never a hang), checkpoint re-shard
+# adaptation, survivor re-partitioning with zero-probability pad
+# lanes, the watchdog shrink rung, and checkpoint-directory
+# durability (fsync after rename).  The end-to-end reshard round trip
+# lives in tests/test_mesh_chaos.py; the multi-process gloo version in
+# tests/test_multihost.py.
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpisppy_tpu.parallel import elastic, mesh as mesh_mod
+from mpisppy_tpu.resilience import FaultPlan, MeshFault, PreemptionError
+from mpisppy_tpu.telemetry import EventBus
+from mpisppy_tpu.telemetry import metrics as _metrics
+
+pytestmark = pytest.mark.chaos
+
+
+class _Cap:
+    def __init__(self):
+        self.events = []
+
+    def handle(self, event):
+        self.events.append(event)
+
+    def kinds(self):
+        return [e.kind for e in self.events]
+
+
+# ---------------------------------------------------------------------------
+# membership: the fleet health ladder applied to mesh hosts
+# ---------------------------------------------------------------------------
+def test_membership_ladder_suspect_then_dead_sticky():
+    cap = _Cap()
+    bus = EventBus()
+    bus.subscribe(cap)
+    mm = elastic.MeshMembership(3, dead_after=2, bus=bus, run="t")
+    assert mm.state(1) == elastic.UP and mm.epoch == 0
+    assert mm.observe(1, fresh=False) == elastic.SUSPECT
+    assert mm.live_hosts() == [0, 1, 2]  # suspicion alone never reshards
+    assert mm.observe(1, fresh=False) == elastic.DEAD
+    assert mm.dead_hosts() == [1] and mm.live_hosts() == [0, 2]
+    # sticky: a zombie's late beat must NOT resurrect it (fencing)
+    assert mm.observe(1, fresh=True) is None
+    assert mm.state(1) == elastic.DEAD
+    assert mm.epoch == 2
+    states = [e.data["state"] for e in cap.events
+              if e.kind == "mesh-state"]
+    assert states == ["SUSPECT", "DEAD"]
+
+
+def test_membership_partition_heals_without_reshard():
+    cap = _Cap()
+    bus = EventBus()
+    bus.subscribe(cap)
+    mm = elastic.MeshMembership(2, dead_after=3, bus=bus, run="t")
+    mm.observe(1, fresh=False)
+    assert mm.state(1) == elastic.SUSPECT
+    assert mm.observe(1, fresh=True) == elastic.UP
+    healed = [e for e in cap.events if e.kind == "mesh-state"
+              and e.data["reason"] == "partition-healed"]
+    assert len(healed) == 1
+    # epoch moved (two transitions) but nobody died: no reshard signal
+    assert mm.epoch == 2 and mm.dead_hosts() == []
+
+
+def test_membership_beacon_files(tmp_path):
+    d = str(tmp_path)
+    writer = elastic.MeshMembership(2, dead_after=2, self_host=1,
+                                    beacon_dir=d)
+    poller = elastic.MeshMembership(2, dead_after=2, self_host=0,
+                                    beacon_dir=d)
+    writer.beat(1)
+    assert os.path.exists(os.path.join(d, "host1.beat"))
+    assert poller.poll() == [] and poller.state(1) == elastic.UP
+    # no new beat: the same counter is stale on the next two sweeps
+    assert poller.poll() == []
+    assert poller.state(1) == elastic.SUSPECT
+    assert poller.poll() == [1]
+    assert poller.state(1) == elastic.DEAD
+    # gauges track the poller's view
+    assert _metrics.REGISTRY.get("mesh_hosts_up") == 1.0
+
+
+def test_partition_seam_suppresses_beacon(tmp_path):
+    d = str(tmp_path)
+    plan = FaultPlan(seed=0, meshes=(
+        MeshFault("partition", host=1, at_beats=(1, 2)),))
+    mm = elastic.MeshMembership(2, dead_after=5, self_host=1,
+                                beacon_dir=d)
+    assert mm.beat(1, plan=plan)           # beat 0: delivered
+    assert not mm.beat(1, plan=plan)       # beats 1, 2: suppressed
+    assert not mm.beat(1, plan=plan)
+    assert mm.beat(1, plan=plan)           # beat 3: window over
+    with open(os.path.join(d, "host1.beat")) as f:
+        assert int(f.read()) == 3
+    assert ("mesh", "partition host1@beat1") in plan.fired
+
+
+# ---------------------------------------------------------------------------
+# MeshFault seams on the plan
+# ---------------------------------------------------------------------------
+def test_mesh_fault_validates_kind():
+    with pytest.raises(ValueError):
+        MeshFault("meteor")
+
+
+def test_host_lost_seam_fires_once():
+    plan = FaultPlan(seed=1, meshes=(
+        MeshFault("host_lost", host=1, at_iters=(3,)),))
+    assert plan.armed
+    assert plan.mesh_lost_host(2) is None
+    assert plan.mesh_lost_host(3) == 1
+    assert plan.mesh_lost_host(3) is None   # fired once
+    assert plan.mesh_lost_host(4) is None
+    assert ("mesh", "host_lost host1 iter3") in plan.fired
+
+
+def test_straggler_seam_fires_once_per_iteration():
+    plan = FaultPlan(seed=1, meshes=(
+        MeshFault("straggler", at_iters=(5,), delay_s=0.25),))
+    assert plan.mesh_harvest_delay(4) == 0.0
+    assert plan.mesh_harvest_delay(5) == 0.25
+    # a resumed run re-executing iter 5 must not re-straggle (the
+    # injected collective was transiently slow — a re-trip would
+    # livelock the elastic runner into its max_reshards budget)
+    assert plan.mesh_harvest_delay(5) == 0.0
+
+
+def test_torn_harvest_seam_fires_once_per_iteration():
+    plan = FaultPlan(seed=1, meshes=(
+        MeshFault("torn_harvest", at_iters=(2,)),))
+    assert not plan.mesh_torn_harvest(1)
+    assert plan.mesh_torn_harvest(2)
+    assert not plan.mesh_torn_harvest(2)
+
+
+# ---------------------------------------------------------------------------
+# the bounded harvest: result, typed error, or re-fetch — never a hang
+# ---------------------------------------------------------------------------
+def test_harvest_deadline_trips_typed_mesh_degraded():
+    cap = _Cap()
+    bus = EventBus()
+    bus.subscribe(cap)
+    before = _metrics.REGISTRY.get("mesh_stragglers_total")
+    rt = elastic.MeshRuntime(deadline_s=0.05, bus=bus, run="t")
+    with pytest.raises(elastic.MeshDegraded) as ei:
+        rt.harvest(lambda: (time.sleep(5.0), np.ones(3))[1], hub_iter=7)
+    assert ei.value.reason == "straggler-deadline"
+    assert ei.value.hub_iter == 7
+    assert isinstance(ei.value, PreemptionError)  # the unwind contract
+    assert _metrics.REGISTRY.get("mesh_stragglers_total") == before + 1
+    ev = [e for e in cap.events if e.kind == "mesh-straggler"]
+    assert ev and ev[0].data["mode"] == "deadline"
+
+
+def test_harvest_straggler_under_deadline_survives():
+    plan = FaultPlan(seed=2, meshes=(
+        MeshFault("straggler", at_iters=(1,), delay_s=0.02),))
+    rt = elastic.MeshRuntime(plan=plan, deadline_s=5.0)
+    vals = rt.harvest(lambda: np.arange(3.0), hub_iter=1)
+    np.testing.assert_array_equal(vals, np.arange(3.0))
+    assert ("mesh", "straggler +0.02s iter1") in plan.fired
+
+
+def test_harvest_torn_transfer_refetches_intact_value():
+    cap = _Cap()
+    bus = EventBus()
+    bus.subscribe(cap)
+    before = _metrics.REGISTRY.get("mesh_torn_harvests_total")
+    plan = FaultPlan(seed=2, meshes=(
+        MeshFault("torn_harvest", at_iters=(4,)),))
+    rt = elastic.MeshRuntime(plan=plan, bus=bus, run="t")
+    vals = rt.harvest(lambda: np.arange(4.0), hub_iter=4)
+    # the tear NaN'd the transfer; the device value was intact and the
+    # synchronous re-fetch recovered it
+    np.testing.assert_array_equal(vals, np.arange(4.0))
+    assert _metrics.REGISTRY.get("mesh_torn_harvests_total") == before + 1
+    ev = [e for e in cap.events if e.kind == "mesh-straggler"]
+    assert ev and ev[0].data["mode"] == "torn"
+
+
+def test_harvest_genuinely_nonfinite_passes_through():
+    # both fetches non-finite: NOT a tear — the hub's own bound guards
+    # own this case, the mesh must not mask it
+    before = _metrics.REGISTRY.get("mesh_torn_harvests_total")
+    rt = elastic.MeshRuntime()
+    vals = rt.harvest(lambda: np.array([np.nan, 1.0]), hub_iter=0)
+    assert np.isnan(vals[0])
+    assert _metrics.REGISTRY.get("mesh_torn_harvests_total") == before
+
+
+def test_harvest_host_lost_raises_and_fences():
+    cap = _Cap()
+    bus = EventBus()
+    bus.subscribe(cap)
+    plan = FaultPlan(seed=3, meshes=(
+        MeshFault("host_lost", host=1, at_iters=(6,)),))
+    mm = elastic.MeshMembership(2, bus=bus, run="t")
+    rt = elastic.MeshRuntime(mm, plan=plan, bus=bus, run="t")
+    assert rt.harvest(lambda: np.zeros(2), hub_iter=5).shape == (2,)
+    with pytest.raises(elastic.MeshDegraded) as ei:
+        rt.harvest(lambda: np.zeros(2), hub_iter=6)
+    assert ei.value.reason == "host-lost" and ei.value.host == 1
+    assert mm.state(1) == elastic.DEAD
+    lost = [e for e in cap.events if e.kind == "mesh-host-lost"]
+    assert lost and lost[0].data["survivors"] == [0]
+
+
+# ---------------------------------------------------------------------------
+# survivor device sets + checkpoint re-shard adaptation
+# ---------------------------------------------------------------------------
+def test_device_groups_and_survivors():
+    devs = jax.devices()
+    groups = elastic.device_groups(devs, 4)
+    assert [len(g) for g in groups] == [2, 2, 2, 2]
+    surv = elastic.survivor_devices(devs, 4, dead_hosts=[1])
+    assert len(surv) == 6
+    assert surv == groups[0] + groups[2] + groups[3]
+
+
+def test_adapt_checkpoint_arrays_repads_scenario_leaves():
+    arrays = {
+        "leaf0": np.arange(8 * 2, dtype=np.float32).reshape(8, 2),
+        "leaf1": np.arange(8.0),              # scenario vector
+        "leaf2": np.arange(4.0),              # not scenario-major
+        "bounds": np.array([1.0, 2.0]),       # meta: untouched
+    }
+    out = elastic.adapt_checkpoint_arrays(arrays, num_real=5,
+                                          s_old=8, s_new=6)
+    assert out["leaf0"].shape == (6, 2)
+    # rows 0..4 are the real prefix; row 5 clones the LAST REAL row
+    np.testing.assert_array_equal(out["leaf0"][:5], arrays["leaf0"][:5])
+    np.testing.assert_array_equal(out["leaf0"][5], arrays["leaf0"][4])
+    assert out["leaf1"].shape == (6,)
+    np.testing.assert_array_equal(out["leaf2"], arrays["leaf2"])
+    np.testing.assert_array_equal(out["bounds"], arrays["bounds"])
+    # identity when the axis is unchanged
+    assert elastic.adapt_checkpoint_arrays(arrays, 5, 8, 8) is arrays
+
+
+# ---------------------------------------------------------------------------
+# re-partitioning: pad lanes carry ZERO probability mass (satellite of
+# ISSUE 17; docs/scengen.md reshard-invariance contract)
+# ---------------------------------------------------------------------------
+def test_repartition_zero_probability_pads():
+    from mpisppy_tpu import scengen
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.scengen.virtual import repartition
+
+    prog = farmer.scenario_program(13, seed=0)
+    vb = scengen.virtual_batch(prog)           # S = 13, no pad
+    rp = repartition(vb, 6)                    # survivor count: 6 -> S=18
+    assert rp.num_scenarios == 18 and rp.num_real == 13
+    p = np.asarray(rp.p)
+    np.testing.assert_allclose(p[:13], np.asarray(vb.p)[:13])
+    np.testing.assert_array_equal(p[13:], np.zeros(5))
+    assert float(p.sum()) == pytest.approx(float(np.asarray(vb.p).sum()))
+
+
+def test_shard_batch_pad_true_uneven_survivors_value_identical():
+    """S=13 real scenarios on a shrunk 6-device survivor mesh: pad=True
+    re-pads to 18 with zero-probability lanes, and every p-weighted
+    reduction matches the 8-device layout up to f32 reduction-order
+    noise (the tolerances of tests/test_sharding.py's layout-parity
+    test) — the pad lanes contribute nothing."""
+    from mpisppy_tpu import scengen
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.models import farmer
+
+    prog = farmer.scenario_program(13, seed=0)
+    opts = ph_mod.PHOptions(subproblem_windows=2, iter0_windows=20)
+    rho = jnp.ones(3, jnp.float32)
+
+    b8 = mesh_mod.shard_batch(scengen.virtual_batch(prog),
+                              mesh_mod.make_mesh(8), pad=True)
+    assert b8.num_scenarios == 16
+    b6 = mesh_mod.shard_batch(scengen.virtual_batch(prog),
+                              mesh_mod.make_mesh(6), pad=True)
+    assert b6.num_scenarios == 18
+
+    st8, tb8, _ = ph_mod.ph_iter0(b8, rho, opts)
+    st6, tb6, _ = ph_mod.ph_iter0(b6, rho, opts)
+    # the certified trivial bound and the consensus xbar are p-weighted
+    # reductions: layout-invariant up to f32 reduction order
+    assert float(tb6) == pytest.approx(float(tb8), rel=1e-4)
+    np.testing.assert_allclose(np.asarray(st6.xbar[0]),
+                               np.asarray(st8.xbar[0]),
+                               rtol=5e-3, atol=1e-2)
+
+
+def test_shard_batch_pad_true_materialized_batch():
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.models import farmer
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    b = batch_mod.from_specs(specs)
+    b6 = mesh_mod.shard_batch(b, mesh_mod.make_mesh(6), pad=True)
+    assert b6.num_scenarios == 6
+    p = np.asarray(b6.p)
+    np.testing.assert_array_equal(p[3:], np.zeros(3))
+    assert float(p.sum()) == pytest.approx(1.0)
+    # pad=False keeps the strict contract
+    with pytest.raises(ValueError):
+        mesh_mod.shard_batch(b, mesh_mod.make_mesh(6))
+
+
+# ---------------------------------------------------------------------------
+# watchdog shrink rung: degrade -> shrink -> abort, never wedged
+# ---------------------------------------------------------------------------
+class _HubStub:
+    telemetry = None
+    run_id = "t"
+    options: dict = {}
+
+
+def _trip_n(wd, n):
+    for _ in range(n):
+        wd._trip(999.0)
+
+
+def test_watchdog_shrink_ladder():
+    from mpisppy_tpu.resilience.watchdog import HubWatchdog
+    calls, aborts = [], []
+    wd = HubWatchdog(_HubStub(), budget_s=1e9, action="shrink",
+                     abort_fn=aborts.append,
+                     shrink_fn=lambda stalled: calls.append(stalled) or True)
+    _trip_n(wd, 1)
+    assert wd.degraded and not wd.shrunk and not aborts
+    _trip_n(wd, 1)
+    assert wd.shrunk and len(calls) == 1 and not aborts
+    _trip_n(wd, 1)
+    assert aborts == [75]           # third rung: abort (EX_TEMPFAIL)
+
+
+def test_watchdog_failed_shrink_escalates_to_abort():
+    from mpisppy_tpu.resilience.watchdog import HubWatchdog
+    aborts = []
+
+    def bad_shrink(stalled):
+        raise RuntimeError("no survivors")
+
+    wd = HubWatchdog(_HubStub(), budget_s=1e9, action="shrink",
+                     abort_fn=aborts.append, shrink_fn=bad_shrink)
+    _trip_n(wd, 3)
+    # a failing shrink is attempted ONCE, then the ladder aborts —
+    # it never retries shrink forever
+    assert not wd.shrunk and aborts == [75]
+
+
+def test_watchdog_shrink_without_fn_degrades_then_aborts():
+    from mpisppy_tpu.resilience.watchdog import HubWatchdog
+    aborts = []
+    wd = HubWatchdog(_HubStub(), budget_s=1e9, action="shrink",
+                     abort_fn=aborts.append)
+    _trip_n(wd, 2)
+    assert wd.degraded and aborts == [75]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability: the spool directory is fsynced after the
+# rename (satellite of ISSUE 17) — a crash right after save cannot
+# roll the directory entry back
+# ---------------------------------------------------------------------------
+def test_fsync_dir_smoke(tmp_path):
+    from mpisppy_tpu.utils import atomic_io
+    p = tmp_path / "f.txt"
+    p.write_text("x")
+    atomic_io.fsync_dir(str(p))            # file path: fsyncs parent
+    atomic_io.fsync_dir(str(tmp_path))     # dir path: fsyncs itself
+    atomic_io.fsync_dir(str(tmp_path / "missing" / "f"))  # silent no-op
+
+
+def test_checkpoint_rename_then_dir_fsync_ordering(tmp_path, monkeypatch):
+    """Crash-ordering regression: the spool directory fsync must happen
+    AFTER the final rename lands, and the renamed file must already be
+    visible when it does — otherwise a host crash between rename and
+    fsync could resurrect the old directory entry while the loader
+    already trusted the new one."""
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    from mpisppy_tpu.utils import atomic_io
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+    ckpt = str(tmp_path / "wheel.npz")
+    ws = WheelSpinner({
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 5e-3,
+                                   "checkpoint_path": ckpt,
+                                   "checkpoint_every_s": 1e9}},
+        "opt_class": ph_mod.PH,
+        "opt_kwargs": {"options": ph_mod.PHOptions(
+            default_rho=1.0, max_iterations=3, conv_thresh=0.0,
+            subproblem_windows=4), "batch": batch},
+    }).build()
+    ws.spcomm.main()
+
+    synced = []
+
+    def spy(path):
+        # the rename must already be visible at fsync time
+        synced.append((path, os.path.exists(ckpt)))
+
+    monkeypatch.setattr(atomic_io, "fsync_dir", spy)
+    # hub._write_checkpoint resolves fsync_dir at call time, so the spy
+    # observes the real call site ordering
+    import mpisppy_tpu.cylinders.hub as hub_mod
+    monkeypatch.setattr(hub_mod, "fsync_dir", spy, raising=False)
+    assert ws.spcomm.save_checkpoint(ckpt)
+    assert synced, "no directory fsync after checkpoint rename"
+    path, visible = synced[-1]
+    assert visible, "directory fsync ran before the rename landed"
+    assert os.path.dirname(os.path.abspath(path)) == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# load_checkpoint transform hook (the reshard adaptation seam)
+# ---------------------------------------------------------------------------
+def test_load_checkpoint_transform_applied_after_integrity(tmp_path):
+    from mpisppy_tpu.algos import ph as ph_mod
+    from mpisppy_tpu.core import batch as batch_mod
+    from mpisppy_tpu.cylinders import PHHub
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    specs = [farmer.scenario_creator(nm, num_scens=3)
+             for nm in farmer.scenario_names_creator(3)]
+    batch = batch_mod.from_specs(specs)
+
+    def spinner():
+        return WheelSpinner({
+            "hub_class": PHHub,
+            "hub_kwargs": {"options": {"rel_gap": 5e-3}},
+            "opt_class": ph_mod.PH,
+            "opt_kwargs": {"options": ph_mod.PHOptions(
+                default_rho=1.0, max_iterations=3, conv_thresh=0.0,
+                subproblem_windows=4), "batch": batch},
+        }).build()
+
+    ws = spinner()
+    ws.spcomm.main()
+    ckpt = str(tmp_path / "w.npz")
+    assert ws.spcomm.save_checkpoint(ckpt)
+
+    seen = {}
+
+    def transform(arrays):
+        seen["n_leaves"] = sum(1 for k in arrays if k.startswith("leaf"))
+        seen["has_crc"] = "crc" in arrays
+        return arrays
+
+    ws2 = spinner()
+    ws2.spcomm.load_checkpoint(ckpt, transform=transform)
+    assert seen["n_leaves"] > 0
+    assert ws2.spcomm._iter == ws.spcomm._iter

@@ -19,12 +19,21 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_four_device_dryrun():
+def _worker_env(xla_devices=None):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    coord = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = repo
+    if xla_devices is not None:
+        env["XLA_FLAGS"] = \
+            f"--xla_force_host_platform_device_count={xla_devices}"
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_two_process_four_device_dryrun():
+    coord = f"127.0.0.1:{_free_port()}"
+    env = _worker_env()
     cmd = [sys.executable, "-m",
            "mpisppy_tpu.parallel._multihost_dryrun", coord, "2"]
     procs = [subprocess.Popen(cmd + [str(pid), "4"], env=env,
@@ -47,3 +56,90 @@ def test_two_process_four_device_dryrun():
         convs.append(float(m.group(1)))
     # global reductions: both processes must compute the SAME conv
     assert convs[0] == pytest.approx(convs[1], rel=1e-6), convs
+
+
+@pytest.mark.slow
+def test_elastic_kill_one_host_round_trip(tmp_path):
+    """ISSUE 17 multi-process fault domain: a host dies mid-wheel; the
+    survivor detects it (beacon staleness + bounded harvest), cannot
+    complete the emergency gather without the dead peer, exits 75
+    (restartable) holding the iter-4 SYNCHRONIZED snapshot; a relaunch
+    at the shrunk 6-device topology resumes from that snapshot and
+    reaches the same certified gap as a fault-free baseline — gloo
+    meshes cannot shrink live, so the elastic loop here is a
+    driver-orchestrated restart."""
+    coord = f"127.0.0.1:{_free_port()}"
+    wd = str(tmp_path)
+    cmd = [sys.executable, "-m",
+           "mpisppy_tpu.parallel._elastic_dryrun"]
+    procs = [subprocess.Popen(
+        cmd + ["kill", coord, "2", str(pid), "4", wd],
+        env=_worker_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+    outs = []
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=550)
+        outs.append(out)
+        want = 75 if pid == 0 else 0   # survivor EX_TEMPFAIL, victim dies
+        assert p.returncode == want, \
+            f"pid {pid} rc {p.returncode}\nstdout:\n{out}\nstderr:\n{err}"
+    m = re.search(r"HOSTLOST reason=([\w-]+) iter=(\d+) "
+                  r"dead=\[1\] ckpt=1", outs[0])
+    assert m, outs[0]
+
+    # relaunch at the survivor topology (6 devices): re-shard 16 -> 18
+    # and spin to the certified gap from the synchronized snapshot
+    res = subprocess.run(cmd + ["resume", wd], env=_worker_env(6),
+                         capture_output=True, text=True, timeout=550)
+    assert res.returncode == 0, res.stderr
+    base = subprocess.run(cmd + ["baseline", wd], env=_worker_env(8),
+                          capture_output=True, text=True, timeout=550)
+    assert base.returncode == 0, base.stderr
+    pat = (r"inner=([\d.e+-]+) outer=([\d.e+-]+) gap=([\d.e+-]+) "
+           r"start=(\d+) iter=(\d+) devices=(\d+)")
+    mr = re.search(r"RESUME " + pat, res.stdout)
+    mb = re.search(r"BASE " + pat, base.stdout)
+    assert mr and mb, (res.stdout, base.stdout)
+    assert mr.group(6) == "6" and mb.group(6) == "8"
+    assert int(mr.group(4)) >= 4          # resumed, not restarted
+    ir, orr, gr = (float(mr.group(i)) for i in (1, 2, 3))
+    ib, ob, gb = (float(mb.group(i)) for i in (1, 2, 3))
+    assert gr <= 5e-3 + 1e-6 and gb <= 5e-3 + 1e-6
+    # both sides bracket the same EF objective
+    slack = 5e-3 * max(abs(ir), abs(ib))
+    assert orr <= ib + slack and ob <= ir + slack
+
+
+@pytest.mark.slow
+def test_elastic_partition_heals_without_reshard(tmp_path):
+    """A partition (suppressed beacon delivery, beats 1-2) only drives
+    the victim to SUSPECT under dead_after=3; the first post-partition
+    beat heals it and the wheel completes with NO reshard — both
+    processes certify the same bracket at the full topology."""
+    coord = f"127.0.0.1:{_free_port()}"
+    cmd = [sys.executable, "-m",
+           "mpisppy_tpu.parallel._elastic_dryrun"]
+    procs = [subprocess.Popen(
+        cmd + ["partition", coord, "2", str(pid), "4", str(tmp_path)],
+        env=_worker_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=550)
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
+        outs.append(out)
+    vals = []
+    for out in outs:
+        m = re.search(r"PARTITION_OK inner=([\d.e+-]+) "
+                      r"outer=([\d.e+-]+) gap=([\d.e+-]+) iter=(\d+) "
+                      r"moves=([\w:,-]+) dead=\[\] epoch=(\d+)", out)
+        assert m, out
+        assert "DEAD" not in m.group(5)   # suspicion never killed anyone
+        vals.append((float(m.group(1)), float(m.group(2))))
+    # SPMD: both processes hold the identical bracket
+    assert vals[0] == pytest.approx(vals[1], rel=1e-6)
+    # the poller watched the partitioned host go SUSPECT then heal back
+    # to UP, in that order, with no reshard in between
+    moves0 = re.search(r"moves=([\w:,-]+)", outs[0]).group(1).split(",")
+    assert "1:SUSPECT" in moves0, outs[0]
+    assert "1:UP" in moves0[moves0.index("1:SUSPECT"):], outs[0]

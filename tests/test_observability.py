@@ -903,3 +903,42 @@ def test_gate_r09_r10_fleet_keys_and_migration_milestone(tmp_path):
     assert not rep4["ok"]
     assert any("migrated_reached_gap_frac" in r["metric"]
                for r in rep4["regressions"])
+
+
+def test_gate_r10_r11_mesh_chaos_keys_and_reshard_milestone(tmp_path):
+    """ISSUE 17 gate fixture: the committed r10->r11 pair gates green
+    with the mesh_chaos phase's keys; mesh_reshards_lost_total carries
+    an any-increase gate (a resharded run must never be lost) and
+    reshard_reached_gap_frac a 1.0 ratchet MILESTONE — the resumed
+    post-reshard wheel certifies the same gap as the fault-free run."""
+    r10 = os.path.join(REPO, "BENCH_r10.json")
+    r11 = os.path.join(REPO, "BENCH_r11.json")
+    rep = regress.gate_paths(r10, r11)
+    assert rep["ok"], rep["regressions"]
+    ms = {r["metric"]: r for r in rep["milestones"]}
+    resh = ms["mesh_chaos.reshard.reshard_reached_gap_frac"]
+    assert resh["status"] == "met" and resh["milestone"] == 1.0
+
+    # a later round LOSING a resharded run fails on the any-increase
+    # gate even though the baseline value is 0
+    lost = json.load(open(r11))
+    lost["parsed"]["mesh_chaos"]["reshard"][
+        "mesh_reshards_lost_total"] = 1
+    lost_path = tmp_path / "BENCH_mesh_lost.json"
+    lost_path.write_text(json.dumps(lost))
+    rep2 = regress.gate_paths(r11, str(lost_path))
+    assert not rep2["ok"]
+    assert any("mesh_reshards_lost" in r["metric"]
+               for r in rep2["regressions"])
+
+    # ...and the bound reshard milestone RATCHETS: a chaos round where
+    # the resumed wheel misses its gap target fails from then on
+    miss = json.load(open(r11))
+    miss["parsed"]["mesh_chaos"]["reshard"][
+        "reshard_reached_gap_frac"] = 0.5
+    miss_path = tmp_path / "BENCH_reshard_miss.json"
+    miss_path.write_text(json.dumps(miss))
+    rep3 = regress.gate_paths(r11, str(miss_path))
+    assert not rep3["ok"]
+    assert any("reshard_reached_gap_frac" in r["metric"]
+               for r in rep3["regressions"])

@@ -220,6 +220,56 @@ class Fixtures:
         from mpisppy_tpu.ops import pdhg
         return pdhg.init_state(self.sslp.qp, self.pdhg_opts)
 
+    # -- elastic mesh (ISSUE 17): the harvest kernels at the full and
+    # the shrunk (survivor) topology.  Prime S so the pad count
+    # genuinely differs between the two layouts.
+    @_memo
+    def elastic_mesh(self):
+        from mpisppy_tpu.parallel import mesh as mesh_mod
+        return self.mesh if self.mesh is not None \
+            else mesh_mod.make_mesh(1)
+
+    @_memo
+    def elastic_shrunk_mesh(self):
+        import jax
+
+        from mpisppy_tpu.parallel import elastic, mesh as mesh_mod
+        devs = elastic.survivor_devices(jax.devices(), 2, [1])
+        return mesh_mod.make_mesh(devices=devs)
+
+    def _elastic_batch(self, mesh):
+        from mpisppy_tpu import scengen
+        from mpisppy_tpu.models import farmer as farmer_model
+        from mpisppy_tpu.parallel import mesh as mesh_mod
+        prog = farmer_model.scenario_program(7, seed=0)
+        return mesh_mod.shard_batch(scengen.virtual_batch(prog), mesh,
+                                    pad=True)
+
+    @_memo
+    def elastic_full(self):
+        return self._elastic_batch(self.elastic_mesh)
+
+    @_memo
+    def elastic_shrunk(self):
+        return self._elastic_batch(self.elastic_shrunk_mesh)
+
+    def _elastic_fused_state(self, batch):
+        import jax.numpy as jnp
+
+        from mpisppy_tpu.algos import fused_wheel as fw
+        rho = jnp.ones(batch.num_nonants, jnp.float32)
+        fst, _, _ = fw.fused_iter0(batch, rho, self.ph_opts,
+                                   self.wheel_opts)
+        return fst
+
+    @_memo
+    def elastic_full_state(self):
+        return self._elastic_fused_state(self.elastic_full)
+
+    @_memo
+    def elastic_shrunk_state(self):
+        return self._elastic_fused_state(self.elastic_shrunk)
+
 
 # ---------------------------------------------------------------------------
 # builders (each: Fixtures -> (jitted_fn, args))
@@ -386,6 +436,37 @@ def _b_ph_iterk_virtual(fx):
                              fx.ph_opts)
 
 
+def _b_fused_iterk_elastic(fx):
+    from mpisppy_tpu.algos import fused_wheel as fw
+    return fw.fused_iterk, (fx.elastic_full, fx.elastic_full_state,
+                            fx.ph_opts, fx.wheel_opts, fx.shuffle_id)
+
+
+def _b_fused_iterk_reshard(fx):
+    from mpisppy_tpu.algos import fused_wheel as fw
+    return fw.fused_iterk, (fx.elastic_shrunk, fx.elastic_shrunk_state,
+                            fx.ph_opts, fx.wheel_opts, fx.shuffle_id)
+
+
+def _b_ckpt_gather(fx):
+    # a scenario-sharded solver-x plane stands in for the fused state's
+    # leaf: same sharding + dtype, no fused_iter0 compile on the fast
+    # subset's critical path (the 60s tier-1 budget)
+    import jax
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.cylinders import hub as hub_mod
+    from mpisppy_tpu.parallel import mesh as mesh_mod
+    b = fx.elastic_full
+    ndev = fx.elastic_mesh.devices.size
+    s_pad = -(-b.num_scenarios // ndev) * ndev
+    x = jax.device_put(
+        jnp.zeros((s_pad, b.num_nonants), jnp.float32),
+        mesh_mod.scen_sharding(fx.elastic_mesh))
+    fn = hub_mod._replicated_gather(fx.elastic_mesh)
+    return fn, (x,)
+
+
 # ---------------------------------------------------------------------------
 # the manifest
 # ---------------------------------------------------------------------------
@@ -478,6 +559,21 @@ MANIFEST: tuple[KernelSpec, ...] = (
                "PH iteration fed by a VirtualBatch (concretize path)",
                collectives=_AR, sharded=True, virtual=True,
                temp_budget_bytes=_VIRTUAL_TEMP_BUDGET, fast=True),
+    KernelSpec("fused_iterk_elastic", _b_fused_iterk_elastic,
+               "elastic hub hot step at the FULL topology (sharded "
+               "VirtualBatch, prime S padded for the full mesh)",
+               collectives=_AG_AR, sharded=True, virtual=True,
+               temp_budget_bytes=_VIRTUAL_TEMP_BUDGET),
+    KernelSpec("fused_iterk_reshard", _b_fused_iterk_reshard,
+               "elastic hub hot step at the SHRUNK (survivor) "
+               "topology — the shape run_elastic recompiles after a "
+               "host loss; single survivor, so no collectives",
+               virtual=True, temp_budget_bytes=_VIRTUAL_TEMP_BUDGET),
+    KernelSpec("ckpt_gather", _b_ckpt_gather,
+               "replicated checkpoint gather (hub._replicated_gather "
+               "— the bounded collective under emergency saves)",
+               collectives=frozenset({"all-gather"}), sharded=True,
+               fast=True),
 )
 
 _BY_NAME = {s.name: s for s in MANIFEST}
