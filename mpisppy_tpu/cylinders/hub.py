@@ -535,6 +535,42 @@ class PHHub(Hub):
     def _trace_extra(self) -> dict:
         return {"conv": self.opt._read_conv()}
 
+    def _apply_warm_plane(self, plane: dict):
+        """Seed a rolling-horizon stream's shifted W/x̄ plane
+        (mpc/shift.py) into the PH state at the FIRST sync — the
+        WXBarReader.post_iter0 timing (iter0 has run, so the seeded
+        duals price iteration 1 onward) without the file round-trip:
+        mpc/driver.py threads the plane through options['warm_plane'].
+        Mirrors _restore_from_arrays' fused-state pattern so a fused
+        wheel's wstate stays consistent with opt.state."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        opt = self.opt
+        st = getattr(opt, "state", None)
+        if st is None:
+            return
+        batch = opt.batch
+        dt = st.W.dtype
+        kw = {}
+        if plane.get("W") is not None:
+            kw["W"] = jnp.asarray(np.asarray(plane["W"]), dt)
+        xbj = plane.get("xbar_nodes")
+        if xbj is not None:
+            xbj = jnp.asarray(np.asarray(xbj), dt)
+            kw["xbar_nodes"] = xbj
+            kw["xbar"] = (
+                jnp.take_along_axis(xbj, batch.node_of_slot, axis=0)
+                if batch.tree.num_nodes > 1
+                else jnp.broadcast_to(xbj[0], st.xbar.shape))
+        if not kw:
+            return
+        new = dataclasses.replace(st, **kw)
+        wstate = getattr(opt, "wstate", None)
+        if wstate is not None:
+            opt.wstate = dataclasses.replace(wstate, ph=new)
+        opt.state = new
+
     def sync(self):
         """One hub<->spoke exchange: harvest the spokes' previous async
         results, then launch their next round on a fresh snapshot.
@@ -551,6 +587,8 @@ class PHHub(Hub):
         the per-iteration trace row is EMITTED as a hub-iteration event
         (the legacy self.trace list is a subscriber view)."""
         self._iter += 1
+        if self._iter == 1 and self.options.get("warm_plane") is not None:
+            self._apply_warm_plane(self.options["warm_plane"])
         if self._profiler is not None:
             self._profiler.on_sync(self._iter)
         with _prof.step("wheel_sync", self._iter):

@@ -213,9 +213,29 @@ def _soc_scenario(scenario_name: str, inst: dict, mult: dict
     )
 
 
+def mpc_drift(demand: np.ndarray, step: int) -> np.ndarray:
+    """Deterministic rolling-dispatch load drift for window `step`: a
+    diurnal swing (period 24 decision epochs, ±20%) applied to the base
+    demand — the ccopf analogue of uc's rolled profile (mpc/horizon.py).
+    Pure in {demand, step}, so a resumed stream re-derives window k's
+    data exactly."""
+    return np.asarray(demand) * (
+        1.0 + 0.2 * np.sin(2.0 * np.pi * step / 24.0))
+
+
 def scenario_creator(scenario_name: str, instance: dict | None = None,
                      branching_factors=(3, 3), seed: int = 0,
-                     soc: bool = False, **_ignored) -> ScenarioSpec:
+                     soc: bool = False, mpc_step: int = -1,
+                     **_ignored) -> ScenarioSpec:
+    if mpc_step >= 0:
+        # rolling window `mpc_step` (mpc/horizon.py): re-key the branch
+        # multipliers per step (fresh uncertainty each epoch, still a
+        # pure function of {seed, step}) and drift the load
+        seed = seed + 7919 * int(mpc_step)
+        inst = dict(instance) if instance is not None else \
+            (feeder_instance() if soc else grid_instance())
+        inst["demand"] = mpc_drift(inst["demand"], int(mpc_step))
+        instance = inst
     bfs = tuple(int(b) for b in branching_factors)
     mult = _stage_multipliers(scenario_name, bfs, seed)
     if soc:
@@ -323,12 +343,18 @@ def inparser_adder(cfg):
                       "cone (conic AC relaxation) workload instead of "
                       "the DC approximation",
                       domain=bool, default=False)
+    cfg.add_to_config("ccopf_mpc_step",
+                      description="rolling-horizon window index (mpc/):"
+                      " >= 0 re-keys multipliers and drifts the load "
+                      "per step; -1 = not a rolling window",
+                      domain=int, default=-1)
 
 
 def kw_creator(cfg):
     return {"branching_factors":
             tuple(cfg.get("branching_factors", (3, 3))),
-            "soc": bool(cfg.get("soc", False))}
+            "soc": bool(cfg.get("soc", False)),
+            "mpc_step": int(cfg.get("ccopf_mpc_step", -1))}
 
 
 def scenario_denouement(rank, scenario_name, spec, x=None):

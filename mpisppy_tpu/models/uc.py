@@ -84,6 +84,48 @@ def scenario_demand(inst: dict, scennum: int) -> np.ndarray:
     return inst["profile"] * (1.0 + eps)
 
 
+def mpc_instance(instance: dict, step: int, stride: int = 1) -> dict:
+    """Window `step` of the rolling horizon (mpc/horizon.py): the SAME
+    fleet with the demand profile advanced stride*step hours (periodic
+    diurnal extension) and the step recorded so scenario_creator re-keys
+    the AR(1) noise through fold_in(base, step).  The shared-structure
+    cache is carried over: structure depends on the profile only through
+    profile.max() (the shed bound), which a roll preserves — so every
+    window of a stream shares one sparse A build."""
+    inst = dict(instance)
+    inst["profile"] = np.roll(instance["profile"],
+                              -int(stride) * int(step))
+    inst["mpc_step"] = int(step)
+    inst["mpc_stride"] = int(stride)
+    return inst
+
+
+def _mpc_demand(inst: dict, scennum: int) -> np.ndarray:
+    """Step-re-keyed demand: the scenario_program sampler's EXACT f32
+    jnp ops (W_ar weight sum over threefry normals), eagerly, with the
+    base key folded to the window's step first — so a serve stream's
+    demand is bit-identical to ScenarioProgram.advance(step) synthesis,
+    and eager dispatch (cached by shape, not by closure identity) keeps
+    warm windows recompile-free."""
+    import jax
+    import jax.numpy as jnp
+    from jax import random as jrandom
+
+    from mpisppy_tpu.scengen.program import scen_key
+
+    T = inst["n_hours"]
+    key = jrandom.PRNGKey(inst["seed"])
+    if inst["mpc_step"]:
+        key = jax.random.fold_in(key, inst["mpc_step"])
+    z = jrandom.normal(scen_key(key, scennum), (T,), jnp.float32) * 0.05
+    t_ix = np.arange(T)
+    W_ar = np.where(t_ix[None, :] <= t_ix[:, None],
+                    0.6 ** (t_ix[:, None] - t_ix[None, :]), 0.0)
+    eps = jnp.sum(jnp.asarray(W_ar, jnp.float32) * z[None, :], axis=-1)
+    d = jnp.asarray(inst["profile"], jnp.float32) * (1.0 + eps)
+    return np.asarray(d, np.float64)
+
+
 def _shared_structure(inst: dict):
     """(A, c, l, u, integer, nonant_idx, row markers) —
     scenario-independent; cached on the instance dict so the batch
@@ -227,7 +269,8 @@ def scenario_creator(scenario_name: str, instance: dict | None = None,
         _shared_structure(instance)
     T = instance["n_hours"]
     k = extract_num(scenario_name)
-    d = scenario_demand(instance, k)
+    d = _mpc_demand(instance, k) if "mpc_step" in instance \
+        else scenario_demand(instance, k)
 
     bl = np.full(m, -np.inf)
     bu = np.zeros(m)
@@ -337,13 +380,24 @@ def inparser_adder(cfg):
     cfg.add_to_config("uc_n_gens", "number of thermal units", int, 10)
     cfg.add_to_config("uc_n_hours", "scheduling horizon (hours)", int, 24)
     cfg.add_to_config("uc_seed", "instance seed", int, 0)
+    cfg.add_to_config("uc_mpc_step",
+                      "rolling-horizon window index (mpc/): >= 0 rolls "
+                      "the profile and re-keys demand per step; -1 = "
+                      "not a rolling window", int, -1)
+    cfg.add_to_config("uc_mpc_stride",
+                      "hours the rolling window advances per step",
+                      int, 1)
 
 
 def kw_creator(cfg):
+    instance = synthetic_instance(cfg.get("uc_n_gens", 10),
+                                  cfg.get("uc_n_hours", 24),
+                                  cfg.get("uc_seed", 0))
+    if cfg.get("uc_mpc_step", -1) >= 0:
+        instance = mpc_instance(instance, cfg["uc_mpc_step"],
+                                cfg.get("uc_mpc_stride", 1))
     return {
-        "instance": synthetic_instance(cfg.get("uc_n_gens", 10),
-                                       cfg.get("uc_n_hours", 24),
-                                       cfg.get("uc_seed", 0)),
+        "instance": instance,
         "num_scens": int(cfg["num_scens"]),
         "lp_relax": True,
     }

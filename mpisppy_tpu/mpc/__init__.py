@@ -1,0 +1,35 @@
+###############################################################################
+# Rolling-horizon MPC streams (ISSUE 19 tentpole; docs/mpc.md).
+#
+# Receding-horizon control re-solves a nearly identical stochastic
+# program every step with shifted data — the regime PAPERS.md's
+# accelerated-proximal-gradient MPC line (arXiv:2109.04405) targets with
+# warm-started first-order iterations, and the batched-solve surface
+# MPAX (arXiv:2412.09734) treats as a product.  This package composes
+# the pieces that already landed — W/x̄ warm-start IO, shape-bucketed
+# compile caching, scengen's fold_in(base, step) re-keying, and the
+# latency/throughput serve classes — into that product:
+#
+#   horizon.py  declarative HorizonSpec (window, stride, per-step data
+#               shift) + model hooks for uc and ccopf --soc
+#   shift.py    trace-pure warm-start shift kernel rolling W/x̄/x
+#               forward by the stride (zero warm recompiles)
+#   driver.py   RollingDriver: the shifted wheel to a per-step gap
+#               target, cold-start fallback, typed StepDegraded
+#   stream.py   the serve-layer integration: one long-lived latency
+#               session streaming one solution line per step
+###############################################################################
+from mpisppy_tpu.mpc.driver import RollingDriver, StepDegraded, StepResult
+from mpisppy_tpu.mpc.horizon import (
+    HorizonSpec,
+    ccopf_horizon,
+    horizon_for,
+    uc_horizon,
+)
+from mpisppy_tpu.mpc.shift import ShiftPlan, shift_state, shift_warm_plane
+
+__all__ = [
+    "HorizonSpec", "RollingDriver", "ShiftPlan", "StepDegraded",
+    "StepResult", "ccopf_horizon", "horizon_for", "shift_state",
+    "shift_warm_plane", "uc_horizon",
+]

@@ -1,0 +1,136 @@
+###############################################################################
+# Warm-start shift kernel (ISSUE 19 tentpole, piece 2; docs/mpc.md).
+#
+# Between two MPC steps the decision window advances by `stride`: slot
+# (g, t) of the new window corresponds to slot (g, t + stride) of the
+# old one, so the previous step's converged PH plane — duals W (S, N),
+# node averages x̄ (nodes, N), incumbent nonants x (S, N) — is ROLLED
+# forward along the nonant axis and the tail entries that have no
+# rolled source are SPLICED fresh.  Everything is a single gather:
+#
+#     new[..., i] = old[..., src_idx[i]]          (then W *= 1 - fresh)
+#
+# The splice policy per plane:
+#   W      zeroed on fresh tail slots — a dual carries step-k pricing
+#          information that does not exist yet for a slot entering the
+#          window, and a zero column keeps the p-weighted node-mean-zero
+#          PH invariant (every ROLLED column keeps it automatically:
+#          the same gather applies to all scenarios of a column).
+#   x̄, x   persistence-filled (src_idx points fresh tails at the last
+#          in-window source slot) — the standard receding-horizon
+#          primal initializer.
+#
+# TRACE PURITY / COMPILE STABILITY: shift_state is a module-level jit
+# whose every input is a traced array (src_idx and fresh_mask included —
+# they are DATA, not static), so step 2..K of a stream re-dispatch the
+# step-1 executable: zero warm recompiles, pinned by the compile-count
+# regression test (tests/test_mpc.py) and audited as the `mpc_shift_state`
+# graftir manifest entry (tools/graftlint/ir/manifest.py).
+###############################################################################
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ShiftPlan:
+    """One horizon's nonant-axis shift, as data.
+
+    src_idx:    (N,) int32 — new slot i reads old slot src_idx[i].
+    fresh_mask: (N,) float32 — 1.0 where slot i entered the window this
+                step (no rolled source; W is zeroed there), else 0.0.
+    """
+
+    src_idx: np.ndarray
+    fresh_mask: np.ndarray
+
+    def __post_init__(self):
+        src = np.asarray(self.src_idx, np.int32)
+        fresh = np.asarray(self.fresh_mask, np.float32)
+        if src.shape != fresh.shape or src.ndim != 1:
+            raise ValueError(
+                f"src_idx {src.shape} and fresh_mask {fresh.shape} must "
+                f"be the same (N,) vector")
+        if src.size and (src.min() < 0 or src.max() >= src.size):
+            raise ValueError("src_idx entries must index the same window")
+        object.__setattr__(self, "src_idx", src)
+        object.__setattr__(self, "fresh_mask", fresh)
+
+    @property
+    def num_nonants(self) -> int:
+        return int(self.src_idx.size)
+
+
+def uc_plan(n_gens: int, n_hours: int, stride: int = 1) -> ShiftPlan:
+    """uc nonants are u_{g,t} in g-major layout (slot = g*T + t): hour
+    t of the new window was hour t + stride of the old one; the last
+    `stride` hours of each generator are fresh (persistence-filled from
+    the generator's final in-window hour)."""
+    G, T = int(n_gens), int(n_hours)
+    stride = int(stride)
+    if not (0 < stride <= T):
+        raise ValueError(f"stride {stride} outside (0, {T}]")
+    src = np.empty(G * T, np.int32)
+    fresh = np.zeros(G * T, np.float32)
+    for g in range(G):
+        for t in range(T):
+            rolled = t + stride
+            if rolled < T:
+                src[g * T + t] = g * T + rolled
+            else:
+                src[g * T + t] = g * T + (T - 1)
+                fresh[g * T + t] = 1.0
+    return ShiftPlan(src_idx=src, fresh_mask=fresh)
+
+
+def ccopf_plan(n_gens: int) -> ShiftPlan:
+    """ccopf nonants are generator setpoints at stages 1 and 2
+    (stage-major, N = 2*ng): advancing one decision epoch makes the old
+    stage-2 plan the new stage-1 plan, and the new stage-2 slots are
+    fresh (persistence-filled from old stage 2)."""
+    ng = int(n_gens)
+    src = np.concatenate([np.arange(ng, 2 * ng),
+                          np.arange(ng, 2 * ng)]).astype(np.int32)
+    fresh = np.concatenate([np.zeros(ng), np.ones(ng)]).astype(np.float32)
+    return ShiftPlan(src_idx=src, fresh_mask=fresh)
+
+
+def _shift_state_impl(W, xbar_nodes, x_non, src_idx, fresh_mask):
+    import jax.numpy as jnp
+    keep = (1.0 - fresh_mask).astype(W.dtype)
+    return (jnp.take(W, src_idx, axis=-1) * keep,
+            jnp.take(xbar_nodes, src_idx, axis=-1),
+            jnp.take(x_non, src_idx, axis=-1))
+
+
+_shift_state_jit = None
+
+
+def shift_state(W, xbar_nodes, x_non, src_idx, fresh_mask):
+    """THE shift kernel: (W, x̄_nodes, x) rolled by src_idx with fresh-
+    tail W zeroing.  One process-wide jit, every argument traced, so
+    every step of every stream with the same shapes shares one
+    executable (lazily created so importing mpc costs no jax import)."""
+    global _shift_state_jit
+    if _shift_state_jit is None:
+        import jax
+        _shift_state_jit = jax.jit(_shift_state_impl)
+    return _shift_state_jit(W, xbar_nodes, x_non, src_idx, fresh_mask)
+
+
+def shift_warm_plane(plane: dict, plan: ShiftPlan) -> dict:
+    """Host bridge: the end-of-step warm plane (numpy dict with W,
+    xbar_nodes, x) shifted into next step's seed through the jitted
+    kernel.  Deterministic, so a preempted stream that re-shifts the
+    checkpointed plane reproduces the uninterrupted stream exactly."""
+    import jax.numpy as jnp
+    W = np.asarray(plane["W"])
+    dt = W.dtype
+    w, xb, x = shift_state(
+        jnp.asarray(W), jnp.asarray(plane["xbar_nodes"], dt),
+        jnp.asarray(plane["x"], dt),
+        jnp.asarray(plan.src_idx), jnp.asarray(plan.fresh_mask))
+    return {"W": np.asarray(w), "xbar_nodes": np.asarray(xb),
+            "x": np.asarray(x)}

@@ -101,6 +101,12 @@ class ScenarioProgram:
     integer: np.ndarray | None = None
     start: int = 0
     dtype: Any = jnp.float32
+    #: rolling-horizon step (mpc/): step k re-keys EVERY draw through
+    #: fold_in(PRNGKey(base_seed), k) BEFORE the per-scenario fold, so
+    #: consecutive MPC steps resample independently while staying bit-
+    #: reproducible from {base_seed, step} alone (resharding-invariant
+    #: like the per-scenario fold — threefry is stateless).
+    step: int = 0
 
     def __post_init__(self):
         unknown = set(self.varying) - set(FIELDS)
@@ -116,7 +122,21 @@ class ScenarioProgram:
 
     # -- keys -------------------------------------------------------------
     def base_key(self) -> Array:
-        return jax.random.PRNGKey(self.base_seed)
+        key = jax.random.PRNGKey(self.base_seed)
+        if self.step:
+            key = jax.random.fold_in(key, self.step)
+        return key
+
+    def advance(self, step: int) -> "ScenarioProgram":
+        """The MPC step re-key helper (ISSUE 19): the SAME program with
+        its base key folded to step `step` — every scenario draw of the
+        advanced program is bit-identical to synthesizing directly from
+        fold_in(PRNGKey(base_seed), step), under any sharding
+        (tests/test_scengen.py pins this).  Absolute, not relative:
+        advance(k).advance(j) samples step j, not k+j."""
+        if step == self.step:
+            return self
+        return dataclasses.replace(self, step=int(step))
 
     def indices(self) -> np.ndarray:
         return np.arange(self.start, self.start + self.num_scenarios)
@@ -124,11 +144,14 @@ class ScenarioProgram:
     def provenance(self) -> dict:
         """Seed-provenance record (confidence_intervals outputs carry
         it): everything needed to regenerate the exact draws."""
-        return {"scheme": "threefry2x32/fold_in",
+        prov = {"scheme": "threefry2x32/fold_in",
                 "program": self.name,
                 "base_seed": int(self.base_seed),
                 "start": int(self.start),
                 "num_scenarios": int(self.num_scenarios)}
+        if self.step:
+            prov["step"] = int(self.step)
+        return prov
 
     # -- scaling ----------------------------------------------------------
     @property

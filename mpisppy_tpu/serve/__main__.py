@@ -47,6 +47,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-s", type=float, default=None,
                    help="default per-session deadline (typed failure "
                         "on expiry; sessions may override)")
+    p.add_argument("--step-miss-budget", type=int, default=3,
+                   help="consecutive per-step deadline misses before "
+                        "a RUNNING MPC stream is reaped (ISSUE 19; "
+                        "streams set step_deadline_s per session)")
     p.add_argument("--no-multiplex", action="store_true",
                    help="run sessions on the synchronous hub without "
                         "the exchange interleave ring")
@@ -74,6 +78,7 @@ def main(argv=None) -> int:
         latency_burst=args.latency_burst,
         trace_dir=args.trace_dir, spool_dir=args.spool_dir,
         default_deadline_s=args.deadline_s,
+        step_miss_budget=args.step_miss_budget,
         multiplex=not args.no_multiplex)
     server = WheelServer(opts).start()
     print(f"serving on {server.address}")  # telemetry: allow-print

@@ -48,7 +48,8 @@ class AdmissionRejected(RuntimeError):
 
 class _Tenant:
     __slots__ = ("name", "weight", "quota", "vfinish", "queue",
-                 "inflight", "admitted", "rejected", "ordinals")
+                 "inflight", "admitted", "rejected", "ordinals",
+                 "steps_charged")
 
     def __init__(self, name: str, weight: float, quota: int):
         self.name = name
@@ -60,6 +61,7 @@ class _Tenant:
         self.admitted = 0
         self.rejected = 0
         self.ordinals = 0      # per-tenant admission ordinal counter
+        self.steps_charged = 0  # MPC stream windows billed (ISSUE 19)
 
 
 class FairQueue:
@@ -202,6 +204,20 @@ class FairQueue:
             t = self._tenant(session.tenant)
             t.inflight = max(0, t.inflight - 1)
 
+    def charge_step(self, session) -> None:
+        """Bill one completed MPC stream window against the tenant's
+        WFQ clock (ISSUE 19): each step advances vfinish exactly like a
+        fresh admission, so a long-lived stream keeps paying virtual
+        service per window and can never starve throughput tenants off
+        a single admission-time charge.  Quota and the SLA burst
+        counter are NOT touched — the stream still holds its one
+        admission slot."""
+        with self._lock:
+            t = self._tenant(session.tenant)
+            self._vtime = max(self._vtime, t.vfinish)
+            t.vfinish = self._vtime + 1.0 / t.weight
+            t.steps_charged += 1
+
     # -- lifecycle / stats ------------------------------------------------
     def drain(self) -> list:
         """Stop admitting: every queued session is returned for typed
@@ -227,6 +243,7 @@ class FairQueue:
                         "inflight": t.inflight,
                         "admitted": t.admitted,
                         "rejected": t.rejected,
+                        "steps_charged": t.steps_charged,
                         "weight": t.weight,
                         "quota": t.quota,
                         "vfinish": round(t.vfinish, 4),

@@ -51,6 +51,9 @@ _MODEL_ARGS = {
     "sslp": ("--sslp-lp-relax", "--default-rho", "20.0"),
     "uc": ("--uc-n-gens", "3", "--uc-n-hours", "6",
            "--slammax", "--sensi-rho", "--subproblem-windows", "10"),
+    # 3-stage OPF on the default (3, 3) tree; clients opt into the
+    # conic branch-flow mode with --soc in their args
+    "ccopf": (),
 }
 
 
@@ -129,6 +132,12 @@ class WheelEngine:
         ('preempted', payload); raises on a failed solve (the server
         types it for the client)."""
         from mpisppy_tpu.spin_the_wheel import WheelSpinner
+        if getattr(session, "streaming", False):
+            # rolling-horizon MPC stream (ISSUE 19): one long-lived
+            # session, one wheel per window, per-step protocol lines +
+            # per-step WFQ charging + its own stream checkpoint
+            from mpisppy_tpu.mpc.stream import run_stream
+            return run_stream(session, fault_plan=fault_plan)
         if fault_plan is not None:
             # serve chaos seams: an injected hang consumes the session
             # deadline, an injected poison surfaces as a typed failure

@@ -48,6 +48,7 @@ MODELS = {
     "farmer": "mpisppy_tpu.models.farmer",
     "sslp": "mpisppy_tpu.models.sslp",
     "uc": "mpisppy_tpu.models.uc",
+    "ccopf": "mpisppy_tpu.models.ccopf",
 }
 
 #: terminal client-visible events — exactly one per session
@@ -74,6 +75,13 @@ class SubmitRequest:
     deadline_s: float | None = None
     max_iterations: int = 200
     args: tuple[str, ...] = ()
+    #: rolling-horizon stream (ISSUE 19, docs/mpc.md): > 0 makes this a
+    #: long-lived MPC session streaming one `step` line per window;
+    #: step_deadline_s arms the PER-STEP deadline the streaming reaper
+    #: enforces (consecutive-miss budget) instead of deadline_s' wall
+    #: clock
+    mpc_steps: int = 0
+    step_deadline_s: float | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SubmitRequest":
@@ -110,9 +118,25 @@ class SubmitRequest:
         if not isinstance(args, (list, tuple)) \
                 or not all(isinstance(a, str) for a in args):
             raise ProtocolError("'args' must be a list of strings")
+        try:
+            mpc_steps = int(d.get("mpc_steps", 0))
+        except (TypeError, ValueError) as e:
+            raise ProtocolError(f"bad mpc_steps: {e}") from e
+        if mpc_steps < 0:
+            raise ProtocolError("mpc_steps must be >= 0")
+        sddl = d.get("step_deadline_s")
+        if sddl is not None:
+            sddl = float(sddl)
+            if sddl <= 0:
+                raise ProtocolError("step_deadline_s must be positive")
+        if sddl is not None and not mpc_steps:
+            raise ProtocolError(
+                "step_deadline_s only applies to an MPC stream "
+                "(mpc_steps > 0)")
         return cls(tenant=tenant, sla=sla, model=model,
                    num_scens=num_scens, gap_target=gap, deadline_s=ddl,
-                   max_iterations=max_iters, args=tuple(args))
+                   max_iterations=max_iters, args=tuple(args),
+                   mpc_steps=mpc_steps, step_deadline_s=sddl)
 
     def to_dict(self) -> dict:
         return {"op": "submit", "tenant": self.tenant, "sla": self.sla,
@@ -120,7 +144,9 @@ class SubmitRequest:
                 "gap_target": self.gap_target,
                 "deadline_s": self.deadline_s,
                 "max_iterations": self.max_iterations,
-                "args": list(self.args)}
+                "args": list(self.args),
+                "mpc_steps": self.mpc_steps,
+                "step_deadline_s": self.step_deadline_s}
 
 
 def encode(obj: dict) -> bytes:

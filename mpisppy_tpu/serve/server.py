@@ -60,6 +60,9 @@ class ServeOptions:
     spool_dir: str | None = None     # session checkpoints
     multiplex: bool = True           # async hub + exchange ring
     default_deadline_s: float | None = None
+    step_miss_budget: int = 3        # consecutive per-step deadline
+                                     # misses before a RUNNING MPC
+                                     # stream is reaped (ISSUE 19)
     engine: object | None = None     # injectable (tests/chaos)
     fault_plan: object | None = None  # chaos seams (ServeFault et al.)
     bus: object | None = None        # server-level telemetry bus
@@ -331,6 +334,12 @@ class WheelServer:
         if session.checkpoint_path is None and self.options.spool_dir:
             session.checkpoint_path = os.path.join(
                 self.options.spool_dir, f"ckpt-{session.sid}.npz")
+        if session.streaming and session.on_step is None:
+            # per-step WFQ charge (ISSUE 19): each completed window
+            # advances the stream's virtual finish time like a fresh
+            # admission, so a long stream keeps paying for capacity
+            # instead of riding one admission forever
+            session.on_step = self.queue.charge_step
         self.queue.submit(session)
         with self._lock:
             self._sessions[session.sid] = session
@@ -399,21 +408,42 @@ class WheelServer:
         semantics): a session past its deadline — queued OR running —
         settles `failed` (reason deadline) NOW; a hung worker is
         abandoned to drain in the background, its quota freed, exactly
-        the dispatch-timeout contract one layer up."""
+        the dispatch-timeout contract one layer up.
+
+        STREAMING sessions (ISSUE 19): a healthy MPC stream outlives
+        any whole-session wall clock by design, so once it is RUNNING
+        (or DEGRADED mid-resume) its liveness unit is the STEP —
+        reaped only after step_miss_budget consecutive per-step
+        deadlines (spec.step_deadline_s, re-armed by every completed
+        window) pass without a step.  deadline_s still bounds its
+        QUEUED wait like any other session."""
         now = time.perf_counter()
+        budget = max(1, int(self.options.step_miss_budget))
         with self._lock:
-            candidates = [s for s in self._sessions.values()
-                          if s.deadline is not None and now >= s.deadline
-                          and not s.is_terminal()]
-        for s in candidates:
+            sessions = [s for s in self._sessions.values()
+                        if not s.is_terminal()]
+        for s in sessions:
             state = s.state
-            if s.settle("failed", reason="deadline",
-                        detail=f"session deadline "
-                               f"{s.spec.deadline_s}s expired in "
-                               f"{state}"):
-                _metrics.REGISTRY.inc("serve_failures_total")
-            if state in (sess_mod.RUNNING, sess_mod.DEGRADED):
+            live = state in (sess_mod.RUNNING, sess_mod.DEGRADED)
+            if s.streaming and live:
+                missed = s.steps_overdue(now)
+                if missed < budget:
+                    continue
+                if s.settle("failed", reason="step-deadline",
+                            detail=f"{missed} consecutive step "
+                                   f"deadlines "
+                                   f"({s.spec.step_deadline_s}s) "
+                                   f"missed at step {s.mpc_step}"):
+                    _metrics.REGISTRY.inc("serve_failures_total")
                 self._release(s)
+            elif s.deadline is not None and now >= s.deadline:
+                if s.settle("failed", reason="deadline",
+                            detail=f"session deadline "
+                                   f"{s.spec.deadline_s}s expired in "
+                                   f"{state}"):
+                    _metrics.REGISTRY.inc("serve_failures_total")
+                if live:
+                    self._release(s)
 
     def _release(self, session):
         """Free the session's worker slot + tenant quota exactly once
@@ -457,6 +487,10 @@ class WheelServer:
                                restore=session.restore)
             session.t_started = session.t_started \
                 or time.perf_counter()
+            if session.streaming:
+                # queue/preemption time must not bill against the
+                # first step's per-step deadline
+                session.reset_step_anchor()
             verdict, payload = self.engine.run(
                 session, ring=self.ring, fault_plan=plan)
             if verdict == "preempted":

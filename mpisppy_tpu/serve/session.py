@@ -125,6 +125,17 @@ class Session:
         # invoked (with this session) after settle() delivers the one
         # terminal outcome — the fleet router's quota-release hook
         self.on_terminal = None
+        # -- rolling-horizon stream surface (ISSUE 19) -- an MPC
+        # session (spec.mpc_steps > 0) is long-lived by design: its
+        # liveness unit is the STEP, not the session.  mpc_step is the
+        # resume cursor (next window to solve — a preempted stream
+        # restores here and re-derives the window bit-identically);
+        # note_step advances it, re-arms the per-step deadline anchor,
+        # and fires on_step (the admission queue's per-step WFQ charge,
+        # server.submit_session wires it).
+        self.mpc_step = 0
+        self.on_step = None
+        self._step_anchor = self.t_submit    # guarded-by: _lock
         self._trace_sink = None    # guarded-by: _lock
         # Lock discipline (tools/graftlint lock-discipline): lifecycle
         # state and the client outbox are touched from the reader
@@ -258,6 +269,46 @@ class Session:
             except Exception:
                 pass   # a router hook must never block the delivery
         return True
+
+    # -- rolling-horizon stream (ISSUE 19) --------------------------------
+    @property
+    def streaming(self) -> bool:
+        """True for an MPC stream session (one solution line per step;
+        reaped on per-step deadline misses, not session wall clock)."""
+        return getattr(self.spec, "mpc_steps", 0) > 0
+
+    def reset_step_anchor(self) -> None:
+        """Re-arm the per-step deadline clock — called when the stream
+        (re)enters RUNNING so queue/preemption time is never billed
+        against the first step's deadline."""
+        with self._lock:
+            self._step_anchor = time.perf_counter()
+
+    def note_step(self, step: int, **info) -> None:
+        """One completed window: advance the resume cursor, re-arm the
+        step deadline, charge the step through WFQ (on_step)."""
+        with self._lock:
+            self.mpc_step = int(step) + 1
+            self._step_anchor = time.perf_counter()
+        cb = self.on_step
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass   # accounting must never kill the stream
+
+    def steps_overdue(self, now: float | None = None) -> int:
+        """Whole per-step deadline windows elapsed since the last
+        completed step — the reaper's consecutive-miss count.  0 when
+        the session has no per-step deadline."""
+        sd = getattr(self.spec, "step_deadline_s", None)
+        if not sd:
+            return 0
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            anchor = self._step_anchor
+        return max(0, int((now - anchor) / float(sd)))
 
     def seconds(self) -> float | None:
         if self.t_finished is None:

@@ -33,7 +33,8 @@ from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     DISPATCH_QUARANTINE, DISPATCH_RETRY, EXCHANGE_OVERLAP,
     FAULT_INJECTED, FLEET_PLACEMENT, HUB_ITERATION, KERNEL_COUNTERS,
     LANE_QUARANTINE, MESH_HOST_LOST, MESH_RESHARD, MESH_STATE,
-    MESH_STRAGGLER, PLANE_WRITE, PROFILE, REPLICA_STATE, RUN_END,
+    MESH_STRAGGLER, MPC_DEGRADED, MPC_STEP, PLANE_WRITE, PROFILE,
+    REPLICA_STATE, RUN_END,
     RUN_START, SESSION_MIGRATED, SESSION_STATE, SPAN,
     SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG, Event,
     new_run_id,

@@ -112,6 +112,8 @@ class RunModel:
     mesh_losses: list = dataclasses.field(default_factory=list)  # elastic
     mesh_reshards: list = dataclasses.field(default_factory=list)
     mesh_stragglers: list = dataclasses.field(default_factory=list)
+    mpc_steps: list = dataclasses.field(default_factory=list)  # stream
+    mpc_degrades: list = dataclasses.field(default_factory=list)
 
     def iter_of(self, it: int) -> HubIter:
         if it not in self.iters:
@@ -240,6 +242,10 @@ def build_run_model(rows: list[dict], run: str | None = None) -> RunModel:
             m.mesh_reshards.append({"iter": it, **data})
         elif kind == ev.MESH_STRAGGLER:
             m.mesh_stragglers.append({"iter": it, **data})
+        elif kind == ev.MPC_STEP:
+            m.mpc_steps.append({"iter": it, **data})
+        elif kind == ev.MPC_DEGRADED:
+            m.mpc_degrades.append({"iter": it, **data})
     return m
 
 
@@ -516,6 +522,33 @@ def _mesh_summary(model: RunModel) -> dict | None:
     }
 
 
+def _mpc_summary(model: RunModel) -> dict | None:
+    """Rolling-horizon stream rows (ISSUE 19): one mpc-step event per
+    solved window (docs/mpc.md), plus mpc-degraded for windows that
+    missed the gap target warm AND cold.  None when the run is not an
+    MPC stream."""
+    if not model.mpc_steps and not model.mpc_degrades:
+        return None
+    lat = [s.get("latency_s") for s in model.mpc_steps
+           if isinstance(s.get("latency_s"), (int, float))]
+    gaps = [s.get("rel_gap") for s in model.mpc_steps
+            if isinstance(s.get("rel_gap"), (int, float))]
+    return {
+        "steps": len(model.mpc_steps),
+        "last_step": max([s.get("step") for s in model.mpc_steps
+                          if s.get("step") is not None], default=None),
+        "warm": sum(1 for s in model.mpc_steps if s.get("warm")),
+        "cold_fallbacks": sum(1 for s in model.mpc_steps
+                              if s.get("cold_fallback")),
+        "degraded": sum(1 for s in model.mpc_steps if s.get("degraded")),
+        "step_latency_p50_s": (round(_median(lat), 6) if lat else None),
+        "step_latency_max_s": (round(max(lat), 6) if lat else None),
+        "last_rel_gap": gaps[-1] if gaps else None,
+        "degraded_at_steps": [d.get("step") for d in model.mpc_degrades
+                              if d.get("step") is not None],
+    }
+
+
 def _async_wheel(model: RunModel) -> dict | None:
     """Plane-staleness + host/device overlap attribution for an async
     wheel run (ISSUE 11): how stale the exchange plane actually ran,
@@ -595,6 +628,7 @@ def analyze(model: RunModel) -> dict:
         "async_wheel": _async_wheel(model),
         "fleet": _fleet_summary(model),
         "mesh": _mesh_summary(model),
+        "mpc": _mpc_summary(model),
     }
     flags = []
     stall = bounds.get("iters_since_outer_moved")
@@ -804,6 +838,19 @@ def render_report(rep: dict) -> str:
                     if msh["stragglers"] else "")
                  + (f"  torn harvests {msh['torn_harvests']}"
                     if msh["torn_harvests"] else ""))
+    mpc = rep.get("mpc")
+    if mpc:
+        L.append(f"mpc stream: steps {mpc['steps']}"
+                 f" (last {_fmt(mpc['last_step'], 'd')})"
+                 f"  warm {mpc['warm']}"
+                 f"  cold fallbacks {mpc['cold_fallbacks']}"
+                 f"  degraded {mpc['degraded']}"
+                 f"  step p50 {_fmt(mpc['step_latency_p50_s'], '.3g')}s"
+                 f"/max {_fmt(mpc['step_latency_max_s'], '.3g')}s"
+                 + (f"  last rel_gap {_fmt(mpc['last_rel_gap'], '.3e')}"
+                    if mpc["last_rel_gap"] is not None else "")
+                 + (f"  degraded at {mpc['degraded_at_steps']}"
+                    if mpc["degraded_at_steps"] else ""))
     res = rep["resilience"]
     if any(v for v in res.values()):
         L.append(f"resilience: faults {res['faults_injected'] or '{}'}  "

@@ -78,6 +78,15 @@ MESH_STRAGGLER = "mesh-straggler"      # elastic mesh: a hub-harvest
                                        # fetch missed its deadline or
                                        # tore; typed MeshDegraded (or a
                                        # clean re-fetch), never a hang
+MPC_STEP = "mpc-step"                  # rolling-horizon stream: one
+                                       # window solved (step, rel_gap,
+                                       # warm/cold, latency_s) —
+                                       # mirrors the client's `step`
+                                       # line (mpc/stream.py)
+MPC_DEGRADED = "mpc-degraded"          # a window missed its gap target
+                                       # warm AND cold (typed
+                                       # StepDegraded; the stream
+                                       # continues on the best iterate)
 SCENGEN = "scengen"                    # a VirtualBatch was built: the
                                        # program, scenario count, base
                                        # seed, and the resident-vs-

@@ -87,6 +87,14 @@ ALL_METRICS = frozenset({
     "fleet_migrations_lost_total",
     "fleet_placement_affinity_total",
     "fleet_placement_spill_total",
+    # rolling-horizon MPC streams (mpisppy_tpu/mpc; ISSUE 19)
+    "mpc_streams_total",
+    "mpc_steps_total",
+    "mpc_warm_steps_total",
+    "mpc_cold_fallbacks_total",
+    "mpc_degraded_steps_total",
+    "mpc_stream_resumes_total",
+    "mpc_step_latency_s",
     # elastic mesh fault domain (parallel/elastic.py; ISSUE 17)
     "mesh_hosts_up",
     "mesh_epoch",

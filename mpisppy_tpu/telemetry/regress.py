@@ -93,6 +93,11 @@ GATES: tuple[tuple[str, str, float], ...] = (
     # loses its run is ALWAYS a regression — the counter stays 0.
     # watchdog_trips rides the any-increase gate above unchanged.
     (r"mesh_reshards_lost", "up", 0.0),
+    # rolling-horizon MPC streams (ISSUE 19; BENCH mpc_stream phase):
+    # client-observed per-step latency on the committed uc / ccopf
+    # horizons regressing past 25% is a serving regression — the warm
+    # path's whole point is the per-window latency class (docs/mpc.md)
+    (r"mpc_stream\..*step_latency_p(50|99)_s$", "up", 0.25),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -150,6 +155,17 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # fault-free baseline — anything under 1.0 means a reshard lost
     # certified progress
     (r"mesh_chaos\..*reshard_reached_gap_frac$", "down", 1.0),
+    # rolling-horizon MPC (ISSUE 19 acceptance; docs/mpc.md): mean
+    # warm step latency pooled over the committed uc + ccopf --soc
+    # horizons must stay <= 0.6x the matching cold re-solves — below
+    # that the receding-horizon product is just repeated cold solves
+    # (the phase's per-model detail records each horizon's own ratio)
+    (r"mpc_stream\.warm_over_cold_ratio$", "up", 0.6),
+    # ...and a stream preempted mid-flight must resume and reproduce
+    # the fault-free stream's per-step bounds exactly (bit-identical
+    # window data + the checkpointed shifted plane): the matched
+    # fraction is 1.0 or the resume story is fiction
+    (r"mpc_stream\..*resumed_matched_frac$", "down", 1.0),
 )
 
 

@@ -59,6 +59,11 @@ class WatchState:
         self.mesh_reshards = 0
         self.mesh_devices = None    # old->new of the latest reshard
         self.mesh_stragglers = 0
+        self.mpc_steps = 0          # MPC streams (ISSUE 19): mpc-step
+        self.mpc_last_step = None   # events on the session trace
+        self.mpc_warm = 0
+        self.mpc_degraded = 0
+        self.mpc_latencies: list = []   # step latency_s tail
         self.ckpt_writes = 0
         self.last_ckpt_wall = None
         self.last_event_wall = None
@@ -114,6 +119,17 @@ class WatchState:
             self.disables += 1
         elif kind == "fault-injected":
             self.faults += 1
+        elif kind == "mpc-step":
+            # rolling-horizon stream (docs/mpc.md): one row per solved
+            # window; degraded windows carry degraded=True here too, so
+            # the paired mpc-degraded event needs no extra counting
+            self.mpc_steps += 1
+            self.mpc_last_step = data.get("step", self.mpc_last_step)
+            self.mpc_warm += 1 if data.get("warm") else 0
+            self.mpc_degraded += 1 if data.get("degraded") else 0
+            if data.get("latency_s") is not None:
+                self.mpc_latencies.append(data["latency_s"])
+                del self.mpc_latencies[:-64]
         elif kind == "checkpoint-write":
             self.ckpt_writes += 1
             self.last_ckpt_wall = row.get("t_wall")
@@ -153,6 +169,11 @@ class WatchState:
         ms = [m for _, m in self.iter_monos]
         deltas = sorted(b - a for a, b in zip(ms, ms[1:]) if b > a)
         return deltas[len(deltas) // 2] if deltas else None
+
+    @property
+    def mpc_step_latency_p50(self) -> float | None:
+        lat = sorted(self.mpc_latencies)
+        return lat[len(lat) // 2] if lat else None
 
 
 def _follow(path: str, state: WatchState, pos: int) -> int:
@@ -251,6 +272,13 @@ def render_status(state: WatchState,
                     if state.mesh_devices else "")
                  + (f"  stragglers/tears {state.mesh_stragglers}"
                     if state.mesh_stragglers else ""))
+    if state.mpc_steps:
+        L.append(f"mpc: steps {state.mpc_steps}"
+                 f" (last {_fmt(state.mpc_last_step, 'd')})"
+                 f"  warm {state.mpc_warm}"
+                 f"  degraded {state.mpc_degraded}"
+                 f"  step p50 "
+                 f"{_fmt(state.mpc_step_latency_p50, '.3g')}s")
     if metrics:
         keys = sorted(k for k in metrics
                       if k.startswith(("dispatch_", "wheel_", "pdhg_")))
@@ -316,6 +344,8 @@ def merge_session_rows(states: dict[str, "WatchState"]) -> list[dict]:
             "replica": chain[-1] if chain else None,
             "migrations": max((s.migrations for _, s in segs),
                               default=0),
+            "mpc_steps": sum(s.mpc_steps for _, s in segs),
+            "step_p50": prim.mpc_step_latency_p50,
         })
     return rows
 
@@ -329,9 +359,13 @@ def render_tenant_table(states: dict[str, "WatchState"]) -> str:
     L: list[str] = []
     rows = merge_session_rows(states)
     fleet = any(r["replica"] for r in rows)
+    mpc = any(r["mpc_steps"] for r in rows)
     rep_w = 9 if fleet else 0
     head = (f"{'session':<10} {'tenant':<10} {'sla':<10} {'state':<9} "
             f"{'iter':>5} {'rel_gap':>9} {'s/iter':>8} {'events':>7}")
+    if mpc:
+        # MPC streams (docs/mpc.md): windows solved + step-latency p50
+        head += f" {'steps':>6} {'step p50':>9}"
     if fleet:
         head += f" {'replica':<9}"
     L.append(head)
@@ -355,6 +389,9 @@ def render_tenant_table(states: dict[str, "WatchState"]) -> str:
                 f"{_fmt_cell(r['rel_gap'], '.3e'):>9} "
                 f"{_fmt_cell(r['sec_per_iter'], '.3g'):>8} "
                 f"{r['events']:>7}")
+            if mpc:
+                line += (f" {_fmt_cell(r['mpc_steps'], 'd'):>6} "
+                         f"{_fmt_cell(r['step_p50'], '.3g'):>9}")
             if fleet:
                 line += f" {'>'.join(r['chain']) or '-':<{rep_w}}"
             L.append(line)

@@ -1,0 +1,441 @@
+# Rolling-horizon MPC streams (mpisppy_tpu/mpc; ISSUE 19, docs/mpc.md):
+# shift-plan/kernel invariants, zero warm recompiles, the serve
+# stream's preempt-resume bit-identity on a real uc horizon, the
+# streaming reaper's per-step miss budget, per-step WFQ charging, and
+# the BENCH_r11 -> r12 gate.
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.mpc.horizon import (
+    HorizonSpec, ccopf_horizon, horizon_for, uc_horizon,
+)
+from mpisppy_tpu.mpc.shift import (
+    ShiftPlan, ccopf_plan, shift_warm_plane, uc_plan,
+)
+from mpisppy_tpu.serve import FairQueue, ServeOptions, SubmitRequest, \
+    WheelServer
+from mpisppy_tpu.serve import loadgen
+from mpisppy_tpu.serve.engine import SyntheticEngine, WheelEngine
+from mpisppy_tpu.serve.session import Session
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(**kw):
+    kw.setdefault("tenant", "acme")
+    kw.setdefault("sla", "latency")
+    kw.setdefault("model", "uc")
+    kw.setdefault("num_scens", 3)
+    return SubmitRequest(**kw)
+
+
+# ---------------------------------------------------------------------------
+# shift plans: the gather indices against a hand-rolled host shift
+# ---------------------------------------------------------------------------
+def test_uc_plan_rolls_hours_and_freshens_tails():
+    """uc slot (g, t) of the new window reads old (g, t + stride);
+    the last `stride` hours of each generator are fresh, persistence-
+    filled from the generator's final in-window hour."""
+    for stride in (1, 2):
+        G, T = 2, 4
+        plan = uc_plan(G, T, stride)
+        assert plan.num_nonants == G * T
+        for g in range(G):
+            for t in range(T):
+                i = g * T + t
+                if t + stride < T:
+                    assert plan.src_idx[i] == g * T + t + stride
+                    assert plan.fresh_mask[i] == 0.0
+                else:
+                    assert plan.src_idx[i] == g * T + (T - 1)
+                    assert plan.fresh_mask[i] == 1.0
+
+
+def test_ccopf_plan_promotes_stage2_to_stage1():
+    """Stage-major (N = 2*ng): old stage 2 becomes new stage 1, new
+    stage 2 is fresh (persistence-filled from old stage 2)."""
+    ng = 3
+    plan = ccopf_plan(ng)
+    assert plan.num_nonants == 2 * ng
+    np.testing.assert_array_equal(
+        plan.src_idx, np.concatenate([np.arange(ng, 2 * ng)] * 2))
+    np.testing.assert_array_equal(
+        plan.fresh_mask, np.r_[np.zeros(ng), np.ones(ng)])
+
+
+def test_shift_plan_and_horizon_validation():
+    with pytest.raises(ValueError, match="same"):
+        ShiftPlan(src_idx=np.zeros(3, np.int32),
+                  fresh_mask=np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="index the same window"):
+        ShiftPlan(src_idx=np.array([0, 5], np.int32),
+                  fresh_mask=np.zeros(2, np.float32))
+    with pytest.raises(ValueError, match="stride"):
+        uc_plan(2, 4, stride=5)
+    with pytest.raises(ValueError, match="bad horizon"):
+        HorizonSpec(name="x", model="uc", window=4, stride=5,
+                    plan=uc_plan(1, 4), base_argv=(),
+                    step_flag="--uc-mpc-step")
+    with pytest.raises(ValueError, match="step"):
+        uc_horizon(n_gens=1, n_hours=4).step_argv(-1)
+
+
+# ---------------------------------------------------------------------------
+# the shift kernel: splice semantics, PH invariant, compile stability
+# ---------------------------------------------------------------------------
+def _rand_plane(rng, S, nodes, N):
+    W = rng.normal(size=(S, N)).astype(np.float32)
+    W -= W.mean(axis=0)     # uniform-p node-mean-zero PH invariant
+    return {"W": W,
+            "xbar_nodes": rng.normal(size=(nodes, N)).astype(np.float32),
+            "x": rng.normal(size=(S, N)).astype(np.float32)}
+
+
+def test_shift_warm_plane_matches_host_gather():
+    """The jitted kernel equals the numpy roll: W gathered then zeroed
+    on fresh tails (rolled columns keep the mean-zero invariant,
+    fresh columns are exactly zero), x̄/x persistence-gathered."""
+    rng = np.random.default_rng(7)
+    plan = uc_plan(2, 4, stride=2)
+    plane = _rand_plane(rng, S=3, nodes=1, N=plan.num_nonants)
+    out = shift_warm_plane(plane, plan)
+    keep = 1.0 - plan.fresh_mask
+    np.testing.assert_array_equal(
+        out["W"], plane["W"][..., plan.src_idx] * keep)
+    np.testing.assert_array_equal(
+        out["xbar_nodes"], plane["xbar_nodes"][..., plan.src_idx])
+    np.testing.assert_array_equal(out["x"], plane["x"][..., plan.src_idx])
+    # invariant: every column of the shifted W still node-mean-zero
+    np.testing.assert_allclose(out["W"].mean(axis=0),
+                               np.zeros(plan.num_nonants), atol=1e-6)
+    # fresh tail duals carry no stale pricing
+    assert np.all(out["W"][:, plan.fresh_mask == 1.0] == 0.0)
+
+
+def test_shift_kernel_zero_recompiles_across_ten_steps():
+    """shift_state is one process-wide jit with every input traced:
+    ten same-shape dispatches with DIFFERENT data (indices included)
+    share one executable — 0 compiles after the first call."""
+    from mpisppy_tpu.dispatch.compilewatch import CompileWatch
+
+    rng = np.random.default_rng(3)
+    plan = uc_plan(2, 6)
+    plane = _rand_plane(rng, S=4, nodes=1, N=plan.num_nonants)
+    watch = CompileWatch()
+    shift_warm_plane(plane, plan)        # pays any first-call compile
+    watch.mark()
+    for k in range(10):
+        plan_k = uc_plan(2, 6, stride=1 + k % 3)
+        plane = shift_warm_plane(plane, plan_k)
+        assert watch.delta() == 0, f"recompile at warm step {k}"
+
+
+# ---------------------------------------------------------------------------
+# the horizon bridge (serve spec -> HorizonSpec)
+# ---------------------------------------------------------------------------
+def test_horizon_for_reads_geometry_and_strips_step_flags():
+    spec = _spec(gap_target=0.02, max_iterations=77,
+                 args=("--uc-n-gens", "2", "--uc-n-hours", "4",
+                       "--uc-mpc-step", "9"), mpc_steps=3)
+    hz = horizon_for(spec)
+    assert hz.model == "uc" and hz.window == 4
+    assert hz.plan.num_nonants == 2 * 4
+    assert hz.gap_target == 0.02 and hz.max_step_iterations == 77
+    # the driver owns the step counter: the stray client copy is gone
+    # and step_argv(k) appends exactly one step flag
+    argv = hz.step_argv(2)
+    assert argv.count("--uc-mpc-step") == 1
+    assert argv[argv.index("--uc-mpc-step") + 1] == "2"
+    # ccopf: --soc routes to the soc horizon, not duplicated in args
+    hz2 = horizon_for(_spec(model="ccopf", num_scens=9,
+                            args=("--soc",), mpc_steps=2))
+    assert hz2.name == "ccopf-soc"
+    assert hz2.base_argv.count("--soc") == 1
+    with pytest.raises(ValueError, match="rolling-horizon"):
+        horizon_for(_spec(model="farmer", mpc_steps=2))
+
+
+# ---------------------------------------------------------------------------
+# real uc streams: one compile warm-up shared by the e2e assertions
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def uc_streams(tmp_path_factory):
+    """Three real 4-step uc streams through the serve engine: the
+    fault-free ground truth (with per-step compile deltas), a stream
+    preempted entering step 2, and its resume from the stream
+    checkpoint."""
+    from mpisppy_tpu.dispatch.compilewatch import CompileWatch
+
+    tmp = tmp_path_factory.mktemp("mpc")
+    eng = WheelEngine(multiplexed=False)
+    steps = 4
+
+    def stream_spec():
+        return _spec(gap_target=0.05, max_iterations=400,
+                     args=("--uc-n-gens", "2", "--uc-n-hours", "4"),
+                     mpc_steps=steps, step_deadline_s=600.0)
+
+    base_lines = []
+    s0 = Session(stream_spec(), outbox=base_lines.append)
+    watch = CompileWatch()
+    deltas = {}
+
+    def _count(sess):
+        deltas[sess.mpc_step - 1] = watch.delta()
+        watch.mark()
+
+    s0.on_step = _count
+    watch.mark()
+    v0 = eng.run(s0)
+
+    chaos_lines = []
+    s1 = Session(stream_spec(), outbox=chaos_lines.append)
+    s1.checkpoint_path = str(tmp / "stream.npz")
+    preempt_at = 2
+    s1.on_step = (lambda sess: sess.preempt_event.set()
+                  if sess.mpc_step == preempt_at else None)
+    v1 = eng.run(s1)
+    ckpt_existed = os.path.exists(s1.checkpoint_path)
+    s1.preempt_event.clear()
+    s1.on_step = None
+    s1.restore = True
+    s1.preemptions += 1
+    v2 = eng.run(s1)
+    # the server's settle latch: exactly one terminal delivery even if
+    # two exit paths race to it (worker + reaper)
+    s1.transition("ADMITTED")
+    s1.transition("RUNNING")
+    settled_first = s1.settle("done", **v2[1])
+    settled_again = s1.settle("done", **v2[1])
+    return {"steps": steps, "verdict0": v0, "deltas": deltas,
+            "settled": (settled_first, settled_again),
+            "base_lines": base_lines, "verdict1": v1, "verdict2": v2,
+            "chaos_lines": chaos_lines, "ckpt_existed": ckpt_existed,
+            "ckpt_path": s1.checkpoint_path, "preempt_at": preempt_at}
+
+
+def _step_lines(lines):
+    return {m["step"]: m for m in lines if m.get("event") == "step"}
+
+
+def test_stream_runs_warm_to_done(uc_streams):
+    """The fault-free stream: every window certifies, steps after the
+    cold start ride the shifted plane (no cold fallbacks, no degrades),
+    and the payload carries the latency-class stats."""
+    verdict, payload = uc_streams["verdict0"]
+    assert verdict == "done"
+    assert payload["steps"] == uc_streams["steps"]
+    assert payload["warm_steps"] == uc_streams["steps"] - 1
+    assert payload["cold_fallbacks"] == 0
+    assert payload["degraded_steps"] == 0
+    assert payload["rel_gap"] <= 0.05 + 1e-9
+    assert payload["step_latency_p50_s"] > 0
+    assert payload["step_latency_p99_s"] >= payload["step_latency_p50_s"]
+    steps = _step_lines(uc_streams["base_lines"])
+    assert sorted(steps) == list(range(uc_streams["steps"]))
+    assert not steps[0]["warm"]
+    assert all(steps[k]["warm"] for k in range(1, uc_streams["steps"]))
+    assert all(len(m["x_root"]) > 0 for m in steps.values())
+
+
+def test_stream_zero_warm_recompiles(uc_streams):
+    """Steps 2+ of a healthy stream re-dispatch the step-1 executables:
+    0 backend compiles per window (step 0 pays the wheel compiles, step
+    1 may compile the one warm-plane application kernel)."""
+    deltas = uc_streams["deltas"]
+    assert sorted(deltas) == list(range(uc_streams["steps"]))
+    for k in range(2, uc_streams["steps"]):
+        assert deltas[k] == 0, f"step {k} recompiled {deltas[k]} kernels"
+
+
+def test_preempted_stream_resumes_bit_identically(uc_streams):
+    """The acceptance chaos round (docs/mpc.md): a stream preempted
+    entering step 2 resumes from the stream checkpoint and reproduces
+    the fault-free per-step bounds exactly, with exactly one terminal
+    outcome and the checkpoint removed on completion."""
+    v1, p1 = uc_streams["verdict1"]
+    assert v1 == "preempted" and p1["step"] == uc_streams["preempt_at"]
+    assert uc_streams["ckpt_existed"]
+    v2, p2 = uc_streams["verdict2"]
+    assert v2 == "done"
+    base = _step_lines(uc_streams["base_lines"])
+    chaos = _step_lines(uc_streams["chaos_lines"])
+    assert sorted(chaos) == sorted(base)
+    for k, b in base.items():
+        c = chaos[k]
+        for f in ("outer", "inner", "rel_gap"):
+            tol = 1e-9 * max(1.0, abs(b[f]))
+            assert abs(b[f] - c[f]) <= tol, (k, f, b[f], c[f])
+    terminals = [m for m in uc_streams["chaos_lines"]
+                 if m.get("event") in ("done", "failed", "rejected")]
+    assert len(terminals) == 1 and terminals[0]["event"] == "done"
+    assert uc_streams["settled"] == (True, False)
+    assert not os.path.exists(uc_streams["ckpt_path"])
+
+
+# ---------------------------------------------------------------------------
+# streaming reaper: per-step miss budget, not session wall clock
+# ---------------------------------------------------------------------------
+def test_steps_overdue_counts_whole_windows():
+    s = Session(_spec(mpc_steps=3, step_deadline_s=0.2))
+    assert s.streaming
+    s.reset_step_anchor()
+    now = time.perf_counter()
+    assert s.steps_overdue(now + 0.19) == 0
+    assert s.steps_overdue(now + 0.41) == 2
+    s.note_step(0)      # a completed window re-arms the clock
+    assert s.mpc_step == 1
+    assert s.steps_overdue(time.perf_counter()) == 0
+    # no per-step deadline -> the reaper never counts misses
+    s2 = Session(_spec(mpc_steps=3))
+    assert s2.steps_overdue(time.perf_counter() + 999.0) == 0
+
+
+def _serve(tmp_path, engine, **kw):
+    kw.setdefault("unix_path", str(tmp_path / "wheel.sock"))
+    kw.setdefault("spool_dir", str(tmp_path / "spool"))
+    kw.setdefault("multiplex", False)
+    kw["engine"] = engine
+    return WheelServer(ServeOptions(**kw)).start()
+
+
+def test_stalled_stream_reaped_on_step_miss_budget(tmp_path):
+    """A RUNNING stream that stops producing steps settles `failed`
+    reason=step-deadline after step_miss_budget consecutive per-step
+    deadlines — typed, never a hang."""
+    eng = SyntheticEngine(iters=400, step_s=0.02)   # never note_steps
+    srv = _serve(tmp_path, eng, step_miss_budget=2)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=30.0)
+        rec = loadgen.run_session(cl, _spec(
+            mpc_steps=3, step_deadline_s=0.1))
+        cl.close()
+    finally:
+        srv.stop()
+    assert rec["outcome"] == "failed"
+    assert rec["reason"] == "step-deadline"
+
+
+def test_healthy_stream_outlives_session_wall_deadline(tmp_path):
+    """A live stream's liveness unit is the STEP: deadline_s bounds its
+    QUEUED wait only, so a stream running past the whole-session wall
+    clock with a healthy step cadence is never wall-reaped."""
+    eng = SyntheticEngine(iters=30, step_s=0.02)    # ~0.6 s run
+    srv = _serve(tmp_path, eng)
+    try:
+        cl = loadgen.ServeClient(srv.address, timeout=30.0)
+        rec = loadgen.run_session(cl, _spec(
+            mpc_steps=2, step_deadline_s=60.0, deadline_s=0.2))
+        cl.close()
+    finally:
+        srv.stop()
+    assert rec["outcome"] == "done", rec
+
+
+# ---------------------------------------------------------------------------
+# per-step WFQ charge
+# ---------------------------------------------------------------------------
+def test_charge_step_bills_wfq_without_touching_quota():
+    """Each completed window advances the tenant's virtual finish time
+    like a fresh admission (so a long-lived stream keeps paying) but
+    holds exactly its one quota slot."""
+    q = FairQueue(max_queued=8, default_quota=2)
+    a = Session(_spec(tenant="A", mpc_steps=4))
+    q.submit(a)
+    assert q.pop() is a
+    st0 = q.stats()["tenants"]["A"]
+    assert st0["inflight"] == 1 and st0["steps_charged"] == 0
+    for _ in range(3):
+        q.charge_step(a)
+    st = q.stats()["tenants"]["A"]
+    assert st["steps_charged"] == 3
+    assert st["vfinish"] > st0["vfinish"]
+    assert st["inflight"] == 1          # quota untouched
+    # fairness effect: the charged tenant is now BEHIND a fresh one
+    q.submit(Session(_spec(tenant="A")))
+    q.submit(Session(_spec(tenant="B")))
+    assert q.pop().tenant == "B"
+
+
+# ---------------------------------------------------------------------------
+# the committed r11 -> r12 gate
+# ---------------------------------------------------------------------------
+def test_bench_r11_r12_gate_and_milestones(tmp_path):
+    """The committed pair gates green with both mpc_stream milestones
+    met; a synthetic p99 regression and a resume-match slip both
+    fail."""
+    from mpisppy_tpu.telemetry import regress
+
+    r11 = os.path.join(REPO, "BENCH_r11.json")
+    r12 = os.path.join(REPO, "BENCH_r12.json")
+    rep = regress.gate_paths(r11, r12)
+    assert rep["ok"], rep["regressions"]
+    ms = {r["metric"]: r for r in rep["milestones"]}
+    ratio = ms["mpc_stream.warm_over_cold_ratio"]
+    assert ratio["status"] == "met" and ratio["milestone"] == 0.6
+    match = ms["mpc_stream.chaos.resumed_matched_frac"]
+    assert match["status"] == "met" and match["milestone"] == 1.0
+
+    # per-step latency is a gated serving metric: p99 +50% fails
+    slow = json.load(open(r12))
+    slow["parsed"]["mpc_stream"]["uc"]["step_latency_p99_s"] *= 1.5
+    slow_path = tmp_path / "slow.json"
+    slow_path.write_text(json.dumps(slow))
+    rep2 = regress.gate_paths(r12, str(slow_path))
+    assert not rep2["ok"]
+    assert any(r["metric"].endswith("uc.step_latency_p99_s")
+               for r in rep2["regressions"])
+
+    # the resume story ratchets at 1.0 once landed
+    slip = json.load(open(r12))
+    slip["parsed"]["mpc_stream"]["chaos"]["resumed_matched_frac"] = 0.5
+    slip_path = tmp_path / "slip.json"
+    slip_path.write_text(json.dumps(slip))
+    rep3 = regress.gate_paths(r12, str(slip_path))
+    assert not rep3["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the analyzer's mpc row (telemetry/analyze.py)
+# ---------------------------------------------------------------------------
+def test_analyze_summarizes_mpc_stream_rows():
+    """The analyzer joins mpc-step/mpc-degraded events into an "mpc"
+    report section (and leaves it None for non-stream runs)."""
+    from mpisppy_tpu.telemetry import analyze as an
+
+    def _row(kind, step, **data):
+        return {"kind": kind, "run": "r1", "cyl": "mpc",
+                "t_wall": 1.0 + step, "t_mono": 1.0 + step,
+                "data": {"step": step, **data}}
+
+    rows = [
+        {"kind": "run-start", "run": "r1", "t_wall": 1.0, "t_mono": 1.0,
+         "data": {"hub_class": "PHHub", "num_spokes": 2}},
+        _row("mpc-step", 0, warm=False, cold_fallback=False,
+             degraded=False, rel_gap=0.03, latency_s=9.0),
+        _row("mpc-step", 1, warm=True, cold_fallback=False,
+             degraded=False, rel_gap=0.02, latency_s=0.8),
+        _row("mpc-step", 2, warm=False, cold_fallback=True,
+             degraded=True, rel_gap=0.09, latency_s=1.2),
+        _row("mpc-degraded", 2, rel_gap=0.09, gap_target=0.05),
+        {"kind": "run-end", "run": "r1", "t_wall": 5.0, "t_mono": 5.0,
+         "data": {"reason": "converged", "rel_gap": 0.09}},
+    ]
+    rep = an.analyze(an.build_run_model(rows))
+    mpc = rep["mpc"]
+    assert mpc["steps"] == 3 and mpc["last_step"] == 2
+    assert mpc["warm"] == 1 and mpc["cold_fallbacks"] == 1
+    assert mpc["degraded"] == 1 and mpc["degraded_at_steps"] == [2]
+    assert mpc["step_latency_p50_s"] == pytest.approx(1.2)
+    assert mpc["step_latency_max_s"] == pytest.approx(9.0)
+    assert mpc["last_rel_gap"] == pytest.approx(0.09)
+    assert "mpc stream: steps 3" in an.render_report(rep)
+
+    # a plain wheel run carries no mpc section
+    plain = an.analyze(an.build_run_model(rows[:1] + rows[-1:]))
+    assert plain["mpc"] is None
+    assert "mpc stream" not in an.render_report(plain)

@@ -317,8 +317,9 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     # a post-win artifact meets the floors in strict mode... (strict
     # requires EVERY milestone phase present, so the synthetic post-win
     # artifact also carries the ISSUE-11 async-overhead phase, the
-    # ISSUE-12 serve isolation phase, the ISSUE-14 scengen phase, and
-    # the ISSUE-16 fleet migration phase)
+    # ISSUE-12 serve isolation phase, the ISSUE-14 scengen phase, the
+    # ISSUE-16 fleet migration phase, the ISSUE-17 mesh reshard phase,
+    # and the ISSUE-19 mpc stream phase)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
@@ -331,6 +332,11 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     won["parsed"]["wheel_scengen"] = {
         "synth_vs_materialized_ratio": 0.97,
         "sweep": [{"scenarios": 1_000_000, "iters_per_sec": 0.07}]}
+    won["parsed"]["mesh_chaos"] = {
+        "reshard": {"reshard_reached_gap_frac": 1.0}}
+    won["parsed"]["mpc_stream"] = {
+        "warm_over_cold_ratio": 0.5,
+        "chaos": {"resumed_matched_frac": 1.0}}
     won_path = tmp_path / "BENCH_won.json"
     won_path.write_text(json.dumps(won))
     rep2 = regress.gate_paths(r06, str(won_path), milestones=True)

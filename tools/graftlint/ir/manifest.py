@@ -390,6 +390,33 @@ def _b_cross_scen_cuts(fx):
                                  fx.pdhg_opts)
 
 
+@functools.lru_cache(maxsize=1)
+def _mpc_shift_kernel():
+    """The MPC warm-start shift kernel's process-wide jit — the SAME
+    executable mpc.shift.shift_state dispatches (shared lazy global,
+    so the audit and a live stream trace one cache entry)."""
+    import jax
+
+    from mpisppy_tpu.mpc import shift as shift_mod
+    if shift_mod._shift_state_jit is None:
+        shift_mod._shift_state_jit = jax.jit(shift_mod._shift_state_impl)
+    return shift_mod._shift_state_jit
+
+
+def _b_mpc_shift(fx):
+    import jax.numpy as jnp
+
+    from mpisppy_tpu.mpc import shift as shift_mod
+    st = fx.ph_state
+    # a stride-1 persistence plan over the farmer nonant axis — the
+    # same roll + fresh-tail gather shape every horizon emits
+    plan = shift_mod.uc_plan(1, fx.farmer.num_nonants)
+    x_non = fx.farmer.nonants(st.solver.x)
+    return _mpc_shift_kernel(), (st.W, st.xbar_nodes, x_non,
+                                 jnp.asarray(plan.src_idx),
+                                 jnp.asarray(plan.fresh_mask))
+
+
 def _b_bnb_round(fx):
     from mpisppy_tpu.ops import bnb as bnb_mod
     int_cols, bst = fx.bnb_state
@@ -569,6 +596,11 @@ MANIFEST: tuple[KernelSpec, ...] = (
                "topology — the shape run_elastic recompiles after a "
                "host loss; single survivor, so no collectives",
                virtual=True, temp_budget_bytes=_VIRTUAL_TEMP_BUDGET),
+    KernelSpec("mpc_shift_state", _b_mpc_shift,
+               "MPC warm-start shift: (W, xbar_nodes, x) rolled along "
+               "the nonant axis by a traced src_idx/fresh_mask gather "
+               "— every stream step re-dispatches one executable",
+               fast=True),
     KernelSpec("ckpt_gather", _b_ckpt_gather,
                "replicated checkpoint gather (hub._replicated_gather "
                "— the bounded collective under emergency saves)",
