@@ -1119,7 +1119,7 @@ def bench_serve_load():
     if base["time_to_gap_p99_s"] and healthy["time_to_gap_p99_s"]:
         ratio = round(healthy["time_to_gap_p99_s"]
                       / base["time_to_gap_p99_s"], 4)
-    return {
+    out = {
         "clients": n_clients,
         "tenants": len(tenants),
         "sessions": base["sessions"],
@@ -1153,6 +1153,11 @@ def bench_serve_load():
                 "ServeFaults) over the no-adversary baseline p99 "
                 "(acceptance <= 1.25)",
     }
+    # ISSUE 20: commit the per-class SLO burn rates alongside the raw
+    # latencies so regress.py's slo.* gates bind on this artifact
+    from mpisppy_tpu.telemetry import slo as _slo
+    out["slo"] = _slo.bench_slo_section({"serve_load": out})
+    return out
 
 
 def bench_mesh_chaos():
@@ -1394,7 +1399,7 @@ def bench_fleet_serve_load():
     multi = {sid: n for sid, n in {**base_terms, **chaos_terms}.items()
              if n > 1}
     lost = _metrics.REGISTRY.get("fleet_migrations_lost_total") - lost0
-    return {
+    out = {
         "replicas": n_replicas,
         "clients": n_clients,
         "sessions": base["sessions"],
@@ -1446,6 +1451,10 @@ def bench_fleet_serve_load():
                 "session must observe exactly one terminal outcome "
                 "and fleet_migrations_lost_total must stay 0",
     }
+    # ISSUE 20: per-class SLO burn rates over the fault-free round
+    from mpisppy_tpu.telemetry import slo as _slo
+    out["slo"] = _slo.bench_slo_section({"fleet_serve_load": out})
+    return out
 
 
 def bench_mpc_stream():
@@ -1567,7 +1576,7 @@ def bench_mpc_stream():
         and close(row["outer"], chaos_steps[k]["outer"])
         and close(row["inner"], chaos_steps[k]["inner"])
         and close(row["rel_gap"], chaos_steps[k]["rel_gap"]))
-    return {
+    out = {
         "steps_per_stream": steps,
         "gap_target": gap,
         "iter_budget_per_step": budget,
@@ -1601,6 +1610,10 @@ def bench_mpc_stream():
                 "must reproduce the fault-free per-step bounds "
                 "bit-for-bit with exactly one terminal outcome",
     }
+    # ISSUE 20: the mpc stream product's step-deadline SLO burn rate
+    from mpisppy_tpu.telemetry import slo as _slo
+    out["slo"] = _slo.bench_slo_section({"mpc_stream": out})
+    return out
 
 
 _PHASES = {

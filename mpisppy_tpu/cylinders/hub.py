@@ -129,6 +129,13 @@ class Hub(SPCommunicator):
         # events and its wheel's events share ONE run in the per-session
         # trace (docs/serving.md); standalone wheels mint a fresh one
         self.run_id = self.options.get("run_id") or tel.new_run_id()
+        # causal trace (ISSUE 20): a serve session's bus arrives
+        # already scoped to the session's segment span — adopt it; a
+        # standalone wheel mints a fresh root so even a bare CLI run
+        # is one complete trace
+        if getattr(self.telemetry, "trace", None) is None \
+                and hasattr(self.telemetry, "set_trace"):
+            self.telemetry.set_trace(tel.TraceContext.mint())
         self._trace_view = tel.WheelTraceView(self)
         self.telemetry.subscribe(self._trace_view)
         self._last_guard_total = 0
@@ -160,7 +167,8 @@ class Hub(SPCommunicator):
             # keep the process-global stamp untouched (their run
             # already matches the scheduler's).
             if self.options.get("run_id"):
-                _dispatch.set_session_context(self.run_id, -1)
+                _dispatch.set_session_context(
+                    self.run_id, -1, **self._trace_token())
         except Exception:
             pass
         # hub progress watchdog (docs/resilience.md): no hub iteration
@@ -198,6 +206,15 @@ class Hub(SPCommunicator):
         """Publish one event for this hub's run (no-op without sinks)."""
         self.telemetry.emit(kind, run=self.run_id, cyl=_cyl,
                             hub_iter=self._iter, **data)
+
+    def _trace_token(self) -> dict:
+        """The bus's current trace/span ids as set_session_context
+        kwargs — how `options['run_id']` hands the causal context to
+        the thread-local DispatchContext (ISSUE 20)."""
+        ctx = getattr(self.telemetry, "trace", None)
+        if ctx is None:
+            return {}
+        return {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
 
     def emit_span(self, name: str, dur_s: float):
         """One timed wheel phase (host wall seconds) onto the stream —
@@ -609,7 +626,8 @@ class PHHub(Hub):
         # other's stamp (see __init__)
         from mpisppy_tpu import dispatch as _dispatch
         if self.options.get("run_id"):
-            _dispatch.set_session_context(self.run_id, self._iter)
+            _dispatch.set_session_context(
+                self.run_id, self._iter, **self._trace_token())
         _dispatch.set_hub_iter(self._iter)
         # live-migration drain (ISSUE 16): the fleet router sets the
         # session's preempt_event to move this wheel; raising here

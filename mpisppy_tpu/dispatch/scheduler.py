@@ -92,17 +92,25 @@ _ctx_local = threading.local()
 @dataclasses.dataclass(frozen=True)
 class DispatchContext:
     """One session's dispatch stamp: the run id of the hub driving this
-    thread and its current hub iteration (-1 pre-wheel)."""
+    thread, its current hub iteration (-1 pre-wheel), and — ISSUE 20 —
+    the causal trace/span ids of the session's current segment, so a
+    MIXED megabatch's event row attributes every lane to the right
+    trace, not just the right run."""
 
     run: str = ""
     hub_iter: int = -1
+    trace_id: str = ""
+    span_id: str = ""
 
 
-def set_session_context(run: str, hub_iter: int = -1) -> None:
+def set_session_context(run: str, hub_iter: int = -1,
+                        trace_id: str = "", span_id: str = "") -> None:
     """Install the calling thread's session token (the hub calls this
     each sync on its driver thread; the serve engine calls it before
     iter0 so warm-up dispatches already join the session)."""
-    _ctx_local.ctx = DispatchContext(run=str(run), hub_iter=int(hub_iter))
+    _ctx_local.ctx = DispatchContext(run=str(run), hub_iter=int(hub_iter),
+                                     trace_id=str(trace_id or ""),
+                                     span_id=str(span_id or ""))
 
 
 def clear_session_context() -> None:
@@ -1062,6 +1070,11 @@ class SolveScheduler:
                                 "lanes": 0, "requests": 0})
             a["lanes"] += S
             a["requests"] += 1
+            # per-trace attribution for mixed megabatches (ISSUE 20):
+            # the session token carries its segment's trace/span ids
+            if ctx.trace_id and "trace_id" not in a:
+                a["trace_id"] = ctx.trace_id
+                a["span_id"] = ctx.span_id
         return list(agg.values())
 
     def _record(self, win: _Window, reqs, sizes, S_pad: int, sig,
@@ -1111,13 +1124,18 @@ class SolveScheduler:
             # scheduler's own run with the per-session breakdown
             # carrying the exact attribution (ISSUE 12 satellite)
             runs = {s["run"] for s in sessions}
-            ev_run, ev_iter = self.run, _hub_iter
+            ev_run, ev_iter, ev_trace = self.run, _hub_iter, None
             if len(sessions) == 1 and sessions[0]["run"]:
                 ev_run = sessions[0]["run"]
                 ev_iter = sessions[0]["iter"]
+                # single-session batch: stamp the row with that
+                # session's segment span (a DispatchContext quacks
+                # like a TraceContext for make_event)
+                ctx0 = reqs[0][6]
+                ev_trace = ctx0 if ctx0.trace_id else None
             self.bus.emit(
                 tel.DISPATCH, run=ev_run, cyl="dispatch",
-                hub_iter=ev_iter,
+                hub_iter=ev_iter, trace=ev_trace,
                 requests=len(sizes), lanes=real, padded_to=S_pad,
                 occupancy=occ, bucket=list(sig[:3]), key=key_label,
                 wait_ms=1e3 * (t_launch - win.t0),

@@ -68,11 +68,21 @@ class Migrator:
             self.started += 1
         session.preempt_event.clear()
         session.migrations += 1
-        # the migration event lands in the SOURCE trace segment (the
-        # sink is still attached), the router stream, and the client
+        # the hand-off opens a dedicated MIGRATION child span under the
+        # request root (ISSUE 20): the source segment already detached
+        # (server._handle_preemption), the destination's begin_segment
+        # opens a sibling — so the wall from this span's start to the
+        # next segment's start IS the migration gap spans.py puts on
+        # the critical path.  The rows land in the SOURCE trace file
+        # (the sink is still attached), the router stream, and the
+        # client.
+        mig = session.trace.child()
         for bus in (session.bus, router.bus):
+            bus.emit(tel.SPAN_START, run=session.run_id, cyl="fleet",
+                     trace=mig, name="migration", session=session.sid,
+                     from_replica=replica.id)
             bus.emit(tel.SESSION_MIGRATED, run=session.run_id,
-                     cyl="fleet", session=session.sid,
+                     cyl="fleet", session=session.sid, trace=mig,
                      tenant=session.tenant,
                      from_replica=replica.id,
                      iter=payload.get("iter"),
@@ -99,6 +109,7 @@ class Migrator:
         router = self.router
         router.bus.emit(tel.SESSION_MIGRATED, run=session.run_id,
                         cyl="fleet", session=session.sid,
+                        trace=session.trace,
                         tenant=session.tenant, from_replica=replica.id,
                         queued=True, migrations=session.migrations)
         router._unassign(session)

@@ -336,6 +336,7 @@ class FleetRouter:
         except adm.AdmissionRejected as e:
             self.bus.emit(tel.ADMISSION_REJECTED, run=session.run_id,
                           cyl="serve", tenant=spec.tenant,
+                          trace=session.trace,
                           reason=e.reason, detail=e.detail)
             _metrics.REGISTRY.inc("serve_admission_rejects_total")
             session.settle("rejected", reason=e.reason,
@@ -364,6 +365,7 @@ class FleetRouter:
             return
         self.bus.emit(tel.ADMISSION_REJECTED, run=session.run_id,
                       cyl="serve", tenant=session.tenant,
+                      trace=session.trace,
                       reason=reason, detail=detail)
         _metrics.REGISTRY.inc("serve_admission_rejects_total")
         session.settle("rejected", reason=reason, detail=detail)
@@ -410,8 +412,11 @@ class FleetRouter:
         _metrics.REGISTRY.inc(
             "fleet_placement_affinity_total" if policy == "affinity"
             else "fleet_placement_spill_total")
+        # stamped with the session's ROOT span: placement is a hop of
+        # the request itself, not of any one run segment (ISSUE 20)
         self.bus.emit(tel.FLEET_PLACEMENT, run=session.run_id,
                       cyl="fleet", session=session.sid,
+                      trace=session.trace,
                       tenant=session.tenant, replica=rep.id,
                       policy=policy, key=session.structure_key,
                       migrations=session.migrations)

@@ -110,7 +110,21 @@ def run_stream(session, fault_plan=None) -> tuple:
 
     latencies, degraded_steps, warm_steps, cold_fallbacks = [], 0, 0, 0
     last = None
+    # per-window spans parent under the session's current segment
+    # (one child span per MPC step — ISSUE 20)
+    seg = getattr(session, "segment", None) \
+        or getattr(session, "trace", None)
     for k in range(start, int(spec.mpc_steps)):
+        step_span = seg.child() if seg is not None else None
+        if step_span is not None:
+            # the window's whole event stream (hub iterations, spans,
+            # dispatch joins) rides the step span; the segment scope is
+            # restored below — or by end_segment on a preemption
+            session.bus.set_trace(step_span)
+        session.bus.emit(
+            tel.SPAN_START, run=session.run_id, cyl="mpc",
+            trace=step_span, name="mpc-step", session=session.sid,
+            step=k)
         t0 = time.perf_counter()
         try:
             res = driver.run_step(k, warm_plane=plane)
@@ -130,6 +144,7 @@ def run_stream(session, fault_plan=None) -> tuple:
         last = res
         session.bus.emit(
             tel.MPC_STEP, run=session.run_id, cyl="mpc",
+            trace=step_span,
             session=session.sid, tenant=session.tenant, step=k,
             outer=res.outer, inner=res.inner, rel_gap=res.rel_gap,
             iterations=res.iterations, warm=res.warm,
@@ -138,6 +153,7 @@ def run_stream(session, fault_plan=None) -> tuple:
         if res.degraded:
             session.bus.emit(
                 tel.MPC_DEGRADED, run=session.run_id, cyl="mpc",
+                trace=step_span,
                 session=session.sid, step=k, rel_gap=res.rel_gap,
                 gap_target=horizon.gap_target)
             _metrics.REGISTRY.inc("mpc_degraded_steps_total")
@@ -147,12 +163,15 @@ def run_stream(session, fault_plan=None) -> tuple:
         if res.cold_fallback:
             _metrics.REGISTRY.inc("mpc_cold_fallbacks_total")
         _metrics.REGISTRY.set_gauge("mpc_step_latency_s", latency)
+        _metrics.REGISTRY.observe("mpc_step_latency_hist_s", latency)
         session.send({
             "event": "step", "session": session.sid, "step": k,
             "outer": res.outer, "inner": res.inner,
             "rel_gap": res.rel_gap, "warm": res.warm,
             "degraded": res.degraded, "latency_s": round(latency, 4),
             "x_root": [round(float(v), 6) for v in res.x_root]})
+    if seg is not None:
+        session.bus.set_trace(seg)   # leave the last step's span
     if session.checkpoint_path:
         try:
             os.remove(session.checkpoint_path)

@@ -422,6 +422,20 @@ def run_elastic(build_fn, *, num_hosts: int, checkpoint_path: str,
         membership = MeshMembership(num_hosts, bus=bus, run=run_id)
     if max_reshards is None:
         max_reshards = num_hosts - 1
+    # causal trace (ISSUE 20): the elastic run is ONE trace; every
+    # build-at-a-topology attempt is a child segment span of the same
+    # root, so a reshard renders as sibling segments with the
+    # MESH_HOST_LOST/MESH_RESHARD rows between them — the reshard gap
+    # on the critical path
+    root = None
+    if bus is not None and hasattr(bus, "set_trace"):
+        from mpisppy_tpu import telemetry as tel
+        root = bus.trace
+        if root is None:
+            root = tel.TraceContext.mint()
+            bus.set_trace(root)
+            bus.emit(tel.SPAN_START, run=run_id, cyl="mesh",
+                     name="mesh-run", num_hosts=num_hosts)
     reshards: list[dict] = []
     prev_s = prev_nreal = None
     while True:
@@ -429,6 +443,13 @@ def run_elastic(build_fn, *, num_hosts: int, checkpoint_path: str,
                                 membership.dead_hosts())
         if not devs:
             raise MeshDegraded("host-lost", detail="no survivors")
+        if root is not None:
+            from mpisppy_tpu import telemetry as tel
+            seg = root.child()
+            bus.set_trace(seg)
+            bus.emit(tel.SPAN_START, run=run_id, cyl="mesh",
+                     name="mesh-segment", devices=len(devs),
+                     epoch=membership.epoch, resumed=bool(reshards))
         mesh = mesh_mod.make_mesh(devices=devs)
         ws = build_fn(mesh)
         ws.build()
@@ -467,7 +488,16 @@ def run_elastic(build_fn, *, num_hosts: int, checkpoint_path: str,
             _metrics.REGISTRY.inc("mesh_reshards_total")
             if bus is not None:
                 from mpisppy_tpu import telemetry as tel
+                # dedicated reshard child span (like a fleet
+                # migration): its start to the next segment's start is
+                # the reshard gap on the critical path
+                rs = root.child() if root is not None else None
+                if rs is not None:
+                    bus.emit(tel.SPAN_START, run=run_id, cyl="mesh",
+                             trace=rs, name="reshard",
+                             epoch=membership.epoch)
                 bus.emit(tel.MESH_RESHARD, run=run_id, cyl="mesh",
+                         trace=rs,
                          old_devices=len(devs),
                          new_devices=len(new_devs),
                          epoch=membership.epoch, hub_iter=e.hub_iter,

@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from mpisppy_tpu.serve.protocol import SubmitRequest, TERMINAL_EVENTS
+from mpisppy_tpu.telemetry.tracecontext import TraceContext
 
 
 class ServeClient:
@@ -87,10 +88,19 @@ def run_session(client: ServeClient, spec: SubmitRequest,
                 wait_terminal: bool = True) -> dict:
     """Submit one session and stream it to its terminal outcome.
     Returns the record the load summary consumes."""
+    # causal trace context (ISSUE 20): the CLIENT mints the root trace
+    # at submit — the server adopts it, so the record's trace_id joins
+    # the client-observed latency to the server-side span tree
+    if getattr(spec, "traceparent", None) is None:
+        import dataclasses as _dc
+        spec = _dc.replace(
+            spec, traceparent=TraceContext.mint().to_traceparent())
+    ctx = TraceContext.from_traceparent(spec.traceparent)
     t0 = time.perf_counter()
     ack = client.submit(spec)
     rec = {"tenant": spec.tenant, "sla": spec.sla, "model": spec.model,
            "submit_t": t0, "session": ack.get("session"),
+           "trace_id": ctx.trace_id if ctx else None,
            "outcome": None, "time_to_gap_s": None, "total_s": None,
            "preempted": 0}
     if not ack.get("ok"):

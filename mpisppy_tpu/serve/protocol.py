@@ -82,6 +82,11 @@ class SubmitRequest:
     #: clock
     mpc_steps: int = 0
     step_deadline_s: float | None = None
+    #: causal trace context (ISSUE 20, docs/telemetry.md): the W3C
+    #: traceparent string minted at client submit.  None/malformed =>
+    #: the Session (or the fleet router) mints a fresh trace — a
+    #: trace-less client still gets a fully traced request.
+    traceparent: str | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SubmitRequest":
@@ -133,10 +138,14 @@ class SubmitRequest:
             raise ProtocolError(
                 "step_deadline_s only applies to an MPC stream "
                 "(mpc_steps > 0)")
+        tp = d.get("traceparent")
+        if tp is not None and not isinstance(tp, str):
+            raise ProtocolError("'traceparent' must be a string")
         return cls(tenant=tenant, sla=sla, model=model,
                    num_scens=num_scens, gap_target=gap, deadline_s=ddl,
                    max_iterations=max_iters, args=tuple(args),
-                   mpc_steps=mpc_steps, step_deadline_s=sddl)
+                   mpc_steps=mpc_steps, step_deadline_s=sddl,
+                   traceparent=tp)
 
     def to_dict(self) -> dict:
         return {"op": "submit", "tenant": self.tenant, "sla": self.sla,
@@ -146,7 +155,8 @@ class SubmitRequest:
                 "max_iterations": self.max_iterations,
                 "args": list(self.args),
                 "mpc_steps": self.mpc_steps,
-                "step_deadline_s": self.step_deadline_s}
+                "step_deadline_s": self.step_deadline_s,
+                "traceparent": self.traceparent}
 
 
 def encode(obj: dict) -> bytes:

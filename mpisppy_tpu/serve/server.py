@@ -308,6 +308,7 @@ class WheelServer:
             # for a hang
             self.bus.emit(tel.ADMISSION_REJECTED, run=session.run_id,
                           cyl="serve", tenant=spec.tenant,
+                          trace=session.trace,
                           reason=e.reason, detail=e.detail)
             _metrics.REGISTRY.inc("serve_admission_rejects_total")
             session.settle("rejected", reason=e.reason, detail=e.detail)
@@ -485,6 +486,10 @@ class WheelServer:
                 _metrics.REGISTRY.inc("serve_disconnects_total")
             session.transition(sess_mod.RUNNING,
                                restore=session.restore)
+            # one causal segment span per run attempt (ISSUE 20):
+            # everything the engine/hub emits below rides this span;
+            # a resumed attempt opens a sibling under the same root
+            session.begin_segment()
             session.t_started = session.t_started \
                 or time.perf_counter()
             if session.streaming:
@@ -530,6 +535,11 @@ class WheelServer:
                            **payload)
         session.send({"event": "preempted", "session": session.sid,
                       **payload})
+        # the preempted attempt's segment span detaches here; the
+        # restore (local requeue or fleet migration) opens a sibling
+        # under the same trace — the wall gap between them IS the
+        # migration gap on the critical path (ISSUE 20)
+        session.end_segment()
         session.restore = True
         if stopping:
             session.settle("failed", reason="draining",

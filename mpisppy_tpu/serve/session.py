@@ -149,9 +149,31 @@ class Session:
         # per-session telemetry scope
         self.bus = tel.EventBus()
         self.trace_path = None
+        # causal trace (ISSUE 20; telemetry/tracecontext.py): adopt the
+        # client's traceparent or mint a fresh root — either way every
+        # event this session (and its hub, dispatch attribution, MPC
+        # windows) emits carries one trace id end to end.  The root
+        # span IS the request; each run attempt opens a child segment
+        # span (begin_segment), so a migration renders as two sibling
+        # segments under one root and the gap between them is the
+        # migration gap.
+        self.trace = tel.TraceContext.from_traceparent(
+            getattr(spec, "traceparent", None)) or tel.TraceContext.mint()
+        self.segment = None        # current run-segment TraceContext
+        self.bus.set_trace(self.trace)
         if trace_dir:
             self.attach_trace(trace_dir)
         self.bus.subscribe(_ClientForwardSink(self))
+        # dual-emit like transition(): in the fleet path the session
+        # bus has no sinks until the replica attaches its trace dir,
+        # so the root span-start must also land on the server/router
+        # stream or the assembled tree loses its root's name
+        for bus in (self.bus, self.server_bus):
+            if bus is not None:
+                bus.emit(tel.SPAN_START, run=self.run_id, cyl="serve",
+                         trace=self.trace, name="request",
+                         session=self.sid, tenant=self.tenant,
+                         sla=self.sla)
 
     # -- per-replica trace attachment (ISSUE 16) --------------------------
     def attach_trace(self, trace_dir: str) -> None:
@@ -181,6 +203,30 @@ class Session:
         with self._lock:
             return self._trace_sink is not None
 
+    # -- run segments (ISSUE 20) ------------------------------------------
+    def begin_segment(self, name: str = "segment", **data):
+        """Open a child span of the request trace for ONE run attempt
+        (one replica hosting, one resume).  The session bus is scoped
+        to it, so every hub/dispatch/MPC event of the attempt carries
+        the segment span; a later attempt (after preemption/migration)
+        opens a sibling segment under the same root."""
+        seg = self.trace.child()
+        self.segment = seg
+        self.bus.set_trace(seg)
+        self.bus.emit(tel.SPAN_START, run=self.run_id, cyl="serve",
+                      name=name, session=self.sid,
+                      replica=self.replica or None,
+                      resume_iter=self.resume_iter,
+                      restore=self.restore, **data)
+        return seg
+
+    def end_segment(self) -> None:
+        """Detach the current segment span (preemption/migration
+        hand-off): subsequent events fall back to the request root
+        until the next begin_segment."""
+        self.segment = None
+        self.bus.set_trace(self.trace)
+
     # -- state machine ----------------------------------------------------
     @property
     def state(self) -> str:
@@ -202,10 +248,11 @@ class Session:
                        sla=self.sla, state=new_state, prev=old)
         if self.replica:
             payload.setdefault("replica", self.replica)
+        trace = self.segment or self.trace
         for bus in (self.bus, self.server_bus):
             if bus is not None:
                 bus.emit(tel.SESSION_STATE, run=self.run_id,
-                         cyl="serve", **payload)
+                         cyl="serve", trace=trace, **payload)
         self.send({"event": "session-state", **payload})
 
     def is_terminal(self) -> bool:
@@ -261,6 +308,26 @@ class Session:
         if self.state != state:       # REJECTED may come straight from
             self.transition(state, **payload)   # QUEUED; others move
         self.send({"event": event, "session": self.sid, **payload})
+        # one terminal SLO sample per session (ISSUE 20; slo.py folds
+        # these into error budgets) — stamped on the request ROOT span,
+        # emitted before the bus closes so the per-session trace ends
+        # on it
+        total_s = self.t_finished - self.t_submit
+        obs = dict(session=self.sid, tenant=self.tenant, sla=self.sla,
+                   outcome=event, total_s=round(total_s, 6),
+                   deadline_s=self.spec.deadline_s,
+                   migrations=self.migrations,
+                   preemptions=self.preemptions)
+        if self.streaming:
+            obs.update(steps=self.mpc_step,
+                       steps_expected=self.spec.mpc_steps,
+                       step_deadline_s=self.spec.step_deadline_s)
+        for bus in (self.bus, self.server_bus):
+            if bus is not None:
+                bus.emit(tel.SLO_OBSERVATION, run=self.run_id,
+                         cyl="serve", trace=self.trace, **obs)
+        _metrics.REGISTRY.observe("slo_session_latency_s", total_s,
+                                  sla=self.sla)
         self.bus.close()
         cb = self.on_terminal
         if cb is not None:

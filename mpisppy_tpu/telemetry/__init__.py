@@ -35,11 +35,12 @@ from mpisppy_tpu.telemetry.events import (  # noqa: F401 (re-exports)
     LANE_QUARANTINE, MESH_HOST_LOST, MESH_RESHARD, MESH_STATE,
     MESH_STRAGGLER, MPC_DEGRADED, MPC_STEP, PLANE_WRITE, PROFILE,
     REPLICA_STATE, RUN_END,
-    RUN_START, SESSION_MIGRATED, SESSION_STATE, SPAN,
-    SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG, Event,
-    new_run_id,
+    RUN_START, SESSION_MIGRATED, SESSION_STATE, SLO_OBSERVATION, SPAN,
+    SPAN_START, SPOKE_DISABLE, SPOKE_HARVEST, SPOKE_STRIKE, WATCHDOG,
+    Event, new_run_id,
 )
 from mpisppy_tpu.telemetry.flightrec import FlightRecorder  # noqa: F401
+from mpisppy_tpu.telemetry.tracecontext import TraceContext  # noqa: F401
 from mpisppy_tpu.telemetry.sinks import (  # noqa: F401
     ConsoleSink, JsonlSink, MetricsSnapshotSink, Sink,
 )

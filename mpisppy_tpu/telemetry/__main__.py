@@ -19,6 +19,15 @@
 #   watch --trace-dir D [--interval S] [--once]
 #       live-tail a DIRECTORY of per-session traces (the serve layer
 #       writes one per session) as a per-tenant session table.
+#   trace [ID] (--trace-dir D | --trace-jsonl T) [--json]
+#       assemble one causal span tree per trace id across per-session /
+#       per-replica / fleet JSONL segments (ISSUE 20): span hierarchy,
+#       migration/reshard spans, critical-path latency buckets; exit 2
+#       on orphan spans (a dropped propagation hop).
+#   slo (--trace-dir D | --trace-jsonl T | --bench B) [--json]
+#       evaluate the declarative SLOs (slo.DEFAULT_SLOS) into error
+#       budgets + burn rates from slo-observation rows or a committed
+#       BENCH artifact; exit 2 on a violated budget.
 #   compare OLD NEW [--json]
 #       diff the perf metrics of two artifacts (analyzer --json
 #       reports, device roofline reports, BENCH_DETAIL.json, or
@@ -73,6 +82,32 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="refresh period, seconds (default 2)")
     pw.add_argument("--once", action="store_true",
                     help="print one status snapshot and exit")
+
+    pt = sub.add_parser("trace", help="assemble one causal span tree "
+                                      "from per-session/fleet JSONL "
+                                      "segments (ISSUE 20)")
+    pt.add_argument("trace_id", nargs="?", default=None,
+                    help="full trace id, a unique prefix, or 'last' "
+                         "(default: the only trace present)")
+    pt.add_argument("--trace-dir", default=None,
+                    help="directory of JSONL segments (per-session, "
+                         "per-replica subdirs, router stream) to join")
+    pt.add_argument("--trace-jsonl", default=None,
+                    help="a single JSONL trace file")
+    pt.add_argument("--json", action="store_true",
+                    help="machine report (schema mpisppy-tpu-trace/1)")
+
+    ps = sub.add_parser("slo", help="evaluate SLO error budgets / "
+                                    "burn rates from traces or a "
+                                    "committed bench artifact")
+    ps.add_argument("--trace-dir", default=None,
+                    help="trace dir: fold its slo-observation rows")
+    ps.add_argument("--trace-jsonl", default=None,
+                    help="a single JSONL trace file")
+    ps.add_argument("--bench", default=None,
+                    help="a BENCH_r*.json artifact: evaluate its "
+                         "serve/fleet/MPC sections")
+    ps.add_argument("--json", action="store_true")
 
     for name, hlp in (("compare", "diff two perf artifacts"),
                       ("gate", "compare + thresholds; exit 2 on "
@@ -133,6 +168,42 @@ def main(argv=None) -> int:
         return w.watch(args.trace_jsonl,
                        metrics_path=args.metrics_snapshot,
                        interval=args.interval, once=args.once)
+
+    if args.cmd == "trace":
+        from mpisppy_tpu.telemetry import spans
+        path = args.trace_dir or args.trace_jsonl
+        if not path:
+            print("trace: need --trace-dir or --trace-jsonl",
+                  file=sys.stderr)
+            return 1
+        try:
+            rep = spans.assemble_path(path, trace=args.trace_id)
+        except (OSError, ValueError) as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(rep) if args.json
+              else spans.render_trace(rep))
+        return 0 if not rep["orphans"] else 2
+
+    if args.cmd == "slo":
+        from mpisppy_tpu.telemetry import regress, slo
+        path = args.trace_dir or args.trace_jsonl
+        if bool(path) == bool(args.bench):
+            print("slo: need exactly one of --trace-dir/--trace-jsonl "
+                  "or --bench", file=sys.stderr)
+            return 1
+        try:
+            if args.bench:
+                rep = slo.evaluate_bench(
+                    regress.load_artifact(args.bench))
+            else:
+                rep = slo.evaluate_path(path)
+        except (OSError, ValueError) as e:
+            print(f"slo: {e}", file=sys.stderr)
+            return 1
+        slo.export_metrics(rep)
+        print(json.dumps(rep) if args.json else slo.render_slo(rep))
+        return 0 if all(r["ok"] for r in rep["slo"].values()) else 2
 
     from mpisppy_tpu.telemetry import regress
     overrides = {}

@@ -52,6 +52,49 @@ def load_trace(path: str) -> list[dict]:
     return rows
 
 
+def join_segments(rows: list[dict],
+                  run: str | None = None) -> tuple[list, list]:
+    """Join a trace DIRECTORY's rows into ONE session's stream (ISSUE
+    20 satellite).  A fleet-migrated session leaves one segment file
+    per replica it ran on (same sid under each replica's subdir) — the
+    segments join on the CAUSAL TRACE ID every row carries, with the
+    (run, session) heuristic only as the fallback for pre-trace rows.
+    Returns (rows of the chosen session sorted by wall clock, the
+    segment files they came from); `run` selects a session, default is
+    the newest."""
+    groups: dict = {}
+    order: list = []
+    for r in rows:
+        key = r.get("trace_id")
+        if not key:
+            sid = (r.get("data") or {}).get("session")
+            key = (r.get("run"), sid) if sid else r.get("_file")
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(r)
+    if not groups:
+        raise ValueError("no rows in the trace directory")
+    if run:
+        target = next((k for k in order
+                       if any(g.get("run") == run for g in groups[k])),
+                      None)
+        if target is None:
+            raise ValueError(f"run {run!r} not in the trace directory")
+    else:
+        target = max(order, key=lambda k: max(
+            (g.get("t_wall") or 0.0) for g in groups[k]))
+    segs = sorted(groups[target],
+                  key=lambda r: (r.get("t_wall") or 0.0,
+                                 r.get("seq") or 0))
+    files: list = []
+    for r in segs:
+        f = r.get("_file")
+        if f and f not in files:
+            files.append(f)
+    return segs, files
+
+
 def runs_in(rows: list[dict]) -> list[str]:
     """Distinct run ids in stream order (a restarted run appends a new
     segment to the same file; ids delimit the segments)."""
@@ -717,9 +760,22 @@ def analyze_path(path: str, run: str | None = None,
                  profile_dir: str | None = None) -> dict:
     """Analyze a JSONL trace; `profile_dir` (or a profile event in the
     trace pointing at a directory that exists here) joins the device
-    section on."""
-    model = build_run_model(load_trace(path), run=run)
+    section on.  `path` may be a trace DIRECTORY (the serve layer's
+    per-session / per-replica layout): the newest session's segments
+    are joined across files on their trace id, so a migrated session
+    analyzes as ONE run instead of losing its pre-migration segment."""
+    import os
+    seg_files: list = []
+    if os.path.isdir(path):
+        from mpisppy_tpu.telemetry import spans as _spans
+        rows, seg_files = join_segments(_spans.load_rows(path), run=run)
+    else:
+        rows = load_trace(path)
+    model = build_run_model(rows, run=run)
     rep = analyze(model)
+    if seg_files:
+        rep["run"]["segment_files"] = seg_files
+        rep["run"]["migrated_segments"] = max(0, len(seg_files) - 1)
     window = profiled_window(model)
     if window:
         rep["profiled_window"] = window
@@ -751,7 +807,10 @@ def render_report(rep: dict) -> str:
     L: list[str] = []
     r, ex = rep["run"], rep["run"]["exit"]
     L.append(f"run {r['id']}  hub={r.get('hub_class') or '?'}  "
-             f"spokes={r.get('num_spokes', '?')}  events={r['events']}")
+             f"spokes={r.get('num_spokes', '?')}  events={r['events']}"
+             + (f"  migrated segments {r['migrated_segments']} "
+                f"({' + '.join(r.get('segment_files') or [])})"
+                if r.get("migrated_segments") else ""))
     L.append(f"exit: {ex.get('reason')}"
              + (f"  rel_gap={_fmt(ex.get('rel_gap'), '.3e')}"
                 if ex.get("rel_gap") is not None else "")

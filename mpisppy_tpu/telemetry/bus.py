@@ -32,7 +32,25 @@ class EventBus:
         self._sinks: list = []             # guarded-by: _lock
         self._errors: dict[int, int] = {}  # guarded-by: _lock
         self._seq = 0                      # guarded-by: _lock
+        self._trace = None                 # guarded-by: _lock
         self.closed = False                # guarded-by: _lock
+
+    # -- trace scoping (ISSUE 20; telemetry/tracecontext.py) --------------
+    def set_trace(self, ctx) -> None:
+        """Scope the bus to a TraceContext: every subsequent emit is
+        stamped with its (trace_id, span_id, parent_span_id) unless the
+        emit passes an explicit `trace=`.  None clears the scope.  A
+        per-session bus is scoped to the session's current segment
+        span; a shared server/router bus stays unscoped and stamps
+        per-emit."""
+        with self._lock:
+            self._trace = ctx
+
+    @property
+    def trace(self):
+        """The current default TraceContext (None when unscoped)."""
+        with self._lock:
+            return self._trace
 
     # -- subscription -----------------------------------------------------
     def subscribe(self, sink) -> None:
@@ -53,15 +71,19 @@ class EventBus:
     # -- emission ---------------------------------------------------------
     def emit(self, kind: str, *, run: str = "", cyl: str = "",
              hub_iter: int | None = None, level: int | None = None,
-             **data) -> ev.Event | None:
+             trace=None, **data) -> ev.Event | None:
         """Publish one event to every subscriber.  Returns the Event (or
-        None when nobody is listening — the no-telemetry fast path)."""
+        None when nobody is listening — the no-telemetry fast path).
+        `trace=` overrides the bus-scoped TraceContext for this one
+        event (the shared-bus attribution path)."""
         with self._lock:
             if not self._sinks or self.closed:
                 return None
             self._seq += 1
             event = ev.make_event(kind, self._seq, run=run, cyl=cyl,
                                   hub_iter=hub_iter, level=level,
+                                  trace=(trace if trace is not None
+                                         else self._trace),
                                   data=data)
             dead = []
             last_err: dict[int, BaseException] = {}

@@ -99,6 +99,22 @@ PROFILE = "profile"                    # profiler lifecycle: "start", or
                                        # capture is VERIFIED on disk
                                        # (analyze auto-discovery key)
 SPAN = "span"                          # one timed wheel phase (host wall)
+SPAN_START = "span-start"              # causal tracing (ISSUE 20): a new
+                                       # named span opened under the
+                                       # row's trace context — segments
+                                       # (one per run attempt/replica),
+                                       # mesh reshard rebuilds, MPC
+                                       # windows.  Spans need no close
+                                       # record: their extent is the
+                                       # [min, max] wall clock of the
+                                       # rows carrying their span_id
+                                       # (torn-tail safe)
+SLO_OBSERVATION = "slo-observation"    # one terminal SLO sample for a
+                                       # session: SLA class, outcome,
+                                       # client-observed total wall,
+                                       # migrations/preemptions, step
+                                       # deadline misses (slo.py folds
+                                       # these into error budgets)
 RUN_START = "run-start"
 RUN_END = "run-end"                    # exit reason + final gap
 
@@ -152,6 +168,11 @@ class Event:
     cyl: str = ""            # producing cylinder ("hub", "spoke0:...", ...)
     hub_iter: int | None = None
     level: int | None = None  # console verbosity level (CONSOLE only)
+    # causal trace context (ISSUE 20; telemetry/tracecontext.py) —
+    # empty on pre-trace rows, stamped by the bus otherwise
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
     data: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
@@ -162,6 +183,11 @@ class Event:
             d["iter"] = self.hub_iter
         if self.level is not None:
             d["level"] = self.level
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+            if self.parent_span_id:
+                d["parent_span_id"] = self.parent_span_id
         d["data"] = _jsonable(self.data)
         return d
 
@@ -171,7 +197,13 @@ class Event:
 
 def make_event(kind: str, seq: int, *, run: str = "", cyl: str = "",
                hub_iter: int | None = None, level: int | None = None,
-               data: dict | None = None) -> Event:
+               trace=None, data: dict | None = None) -> Event:
+    """`trace` is a TraceContext (or any object carrying
+    trace_id/span_id/parent_span_id) — None leaves the row unstamped."""
     return Event(kind=kind, seq=seq, t_wall=time.time(),
                  t_mono=time.perf_counter(), run=run, cyl=cyl,
-                 hub_iter=hub_iter, level=level, data=data or {})
+                 hub_iter=hub_iter, level=level,
+                 trace_id=getattr(trace, "trace_id", "") or "",
+                 span_id=getattr(trace, "span_id", "") or "",
+                 parent_span_id=getattr(trace, "parent_span_id", "") or "",
+                 data=data or {})

@@ -103,7 +103,69 @@ ALL_METRICS = frozenset({
     "mesh_reshards_lost_total",
     "mesh_stragglers_total",
     "mesh_torn_harvests_total",
+    # SLO plane (telemetry/slo.py, serve/session.py; ISSUE 20) —
+    # *_latency_* names are HISTOGRAMS (observe()), the rest gauges
+    "slo_session_latency_s",
+    "slo_burn_rate",
+    "slo_error_budget_remaining",
+    "mpc_step_latency_hist_s",
 })
+
+#: default histogram bucket upper bounds (seconds — the latency scale
+#: every slo_*/mpc latency histogram shares); +Inf is implicit
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram:
+    """One bucketed distribution: cumulative-style bucket counts plus
+    sum/count, the Prometheus histogram data model.  Standalone (no
+    registry required) so stream-following consumers — `telemetry
+    watch`'s per-stream MPC step latencies (ISSUE 20 satellite) — can
+    fold unbounded row streams into O(buckets) state instead of
+    retaining every raw row."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=None):
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self.counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf tail
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        self.counts[i] += 1
+        self.sum += v
+        self.count += 1
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-resolution quantile estimate (linear interpolation
+        inside the landing bucket; the +Inf tail reports its lower
+        bound).  None while empty."""
+        if self.count == 0:
+            return None
+        target = max(0.0, min(1.0, float(q))) * self.count
+        cum = 0
+        lo = 0.0
+        for j, b in enumerate(self.buckets):
+            nxt = cum + self.counts[j]
+            if nxt >= target and self.counts[j] > 0:
+                frac = (target - cum) / self.counts[j]
+                return lo + frac * (b - lo)
+            cum = nxt
+            lo = b
+        return lo
+
+    def to_dict(self) -> dict:
+        return {"buckets": list(self.buckets),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
 
 
 def _key(name: str, labels: dict | None) -> str:
@@ -119,8 +181,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}  # guarded-by: _lock
-        self._gauges: dict[str, float] = {}    # guarded-by: _lock
+        self._counters: dict[str, float] = {}      # guarded-by: _lock
+        self._gauges: dict[str, float] = {}        # guarded-by: _lock
+        self._histograms: dict[str, Histogram] = {}  # guarded-by: _lock
 
     # -- recording --------------------------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels):
@@ -138,6 +201,17 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[_key(name, labels)] = float(value)
 
+    def observe(self, name: str, value: float, buckets=None, **labels):
+        """Record one sample into a histogram series (first-class
+        histogram type, ISSUE 20 — p50/p99 stop being recomputed from
+        retained raw rows)."""
+        k = _key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                h = self._histograms[k] = Histogram(buckets)
+            h.observe(value)
+
     def get(self, name: str, default: float = 0.0, **labels) -> float:
         k = _key(name, labels)
         with self._lock:
@@ -145,21 +219,37 @@ class MetricsRegistry:
                 return self._counters[k]
             return self._gauges.get(k, default)
 
+    def get_histogram(self, name: str, **labels) -> Histogram | None:
+        with self._lock:
+            return self._histograms.get(_key(name, labels))
+
+    def quantile(self, name: str, q: float, **labels) -> float | None:
+        h = self.get_histogram(name, **labels)
+        return None if h is None else h.quantile(q)
+
     def reset(self):
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._histograms.clear()
 
     # -- rendering (the one shared schema) --------------------------------
     def to_snapshot(self) -> dict:
-        """JSON snapshot — the schema bench.py embeds in BENCH_*.json."""
+        """JSON snapshot — the schema bench.py embeds in BENCH_*.json.
+        `histograms` is additive (absent pre-ISSUE-20 artifacts parse
+        identically)."""
         with self._lock:
-            return {
+            snap = {
                 "schema": SNAPSHOT_SCHEMA,
                 "t_wall": time.time(),
                 "counters": dict(sorted(self._counters.items())),
                 "gauges": dict(sorted(self._gauges.items())),
             }
+            if self._histograms:
+                snap["histograms"] = {
+                    k: self._histograms[k].to_dict()
+                    for k in sorted(self._histograms)}
+            return snap
 
     def render_prom(self) -> str:
         """Prometheus text exposition (one sample per line)."""
@@ -175,6 +265,27 @@ class MetricsRegistry:
                     seen_names.add(base)
                     lines.append(f"# TYPE {base} {kind}")
                 lines.append(f"{k} {v!r}")
+        seen_names = set()
+        for k, h in snap.get("histograms", {}).items():
+            base, _, labels = k.partition("{")
+            labels = labels[:-1] if labels else ""
+            if base not in seen_names:
+                seen_names.add(base)
+                lines.append(f"# TYPE {base} histogram")
+
+            def series(suffix, extra=""):
+                inner = ",".join(x for x in (labels, extra) if x)
+                return f"{base}{suffix}" + (f"{{{inner}}}" if inner
+                                            else "")
+            cum = 0
+            for b, c in zip(h["buckets"], h["counts"]):
+                cum += c
+                le = 'le="%s"' % b
+                lines.append(series("_bucket", le) + f" {cum}")
+            cum += h["counts"][-1]
+            lines.append(series("_bucket", 'le="+Inf"') + f" {cum}")
+            lines.append(series("_sum") + " " + repr(h["sum"]))
+            lines.append(series("_count") + " %d" % h["count"])
         return "\n".join(lines) + "\n"
 
 

@@ -98,6 +98,13 @@ GATES: tuple[tuple[str, str, float], ...] = (
     # horizons regressing past 25% is a serving regression — the warm
     # path's whole point is the per-window latency class (docs/mpc.md)
     (r"mpc_stream\..*step_latency_p(50|99)_s$", "up", 0.25),
+    # SLO plane (ISSUE 20; telemetry/slo.py): committed artifacts carry
+    # per-class `slo` sections (burn_rate = violating fraction over the
+    # error budget).  Budget consumption growing past 25% relative is a
+    # serving regression even while still inside the budget; the
+    # absolute <= 1.0 ceiling lives in MILESTONES below.
+    (r"slo\..*\.burn_rate$", "up", 0.25),
+    (r"slo\..*\.budget_remaining$", "down", 0.25),
 )
 
 #: absolute slack added on top of the relative threshold, so integer
@@ -166,6 +173,11 @@ MILESTONES: tuple[tuple[str, str, float], ...] = (
     # window data + the checkpointed shifted plane): the matched
     # fraction is 1.0 or the resume story is fiction
     (r"mpc_stream\..*resumed_matched_frac$", "down", 1.0),
+    # SLO plane (ISSUE 20 acceptance; docs/telemetry.md SLO table): a
+    # committed artifact's per-class burn rate must never exceed 1.0 —
+    # an exhausted error budget IS the violated SLO, regardless of how
+    # gently it got there (the relative gate above catches the drift)
+    (r"slo\..*\.burn_rate$", "up", 1.0),
 )
 
 

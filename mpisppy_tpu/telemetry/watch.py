@@ -22,6 +22,9 @@ import os
 import sys
 import time
 
+from mpisppy_tpu.telemetry import slo as _slo
+from mpisppy_tpu.telemetry.metrics import Histogram
+
 
 class WatchState:
     """Rolling view over one run's event stream (newest run wins —
@@ -63,7 +66,16 @@ class WatchState:
         self.mpc_last_step = None   # events on the session trace
         self.mpc_warm = 0
         self.mpc_degraded = 0
-        self.mpc_latencies: list = []   # step latency_s tail
+        self.mpc_latencies: list = []   # recent step latency_s tail
+                                        # (display only; capped)
+        # ALL step latencies fold into a histogram so the p50 covers
+        # the stream's whole life in O(1) memory — the old tail-only
+        # median silently forgot everything before the last 64 windows
+        # (ISSUE 20 satellite)
+        self.mpc_hist = Histogram()
+        self.trace_id = None        # causal trace id (ISSUE 20) — the
+                                    # migrated-segment join key
+        self.slo_obs: list = []     # slo-observation payloads
         self.ckpt_writes = 0
         self.last_ckpt_wall = None
         self.last_event_wall = None
@@ -82,6 +94,8 @@ class WatchState:
                 return                 # stale cross-run stragglers
         self.events += 1
         self.last_event_wall = row.get("t_wall", self.last_event_wall)
+        if row.get("trace_id") and self.trace_id is None:
+            self.trace_id = row["trace_id"]
         data = row.get("data", {})
         it = row.get("iter")
         if kind == "run-start":
@@ -128,6 +142,7 @@ class WatchState:
             self.mpc_warm += 1 if data.get("warm") else 0
             self.mpc_degraded += 1 if data.get("degraded") else 0
             if data.get("latency_s") is not None:
+                self.mpc_hist.observe(data["latency_s"])
                 self.mpc_latencies.append(data["latency_s"])
                 del self.mpc_latencies[:-64]
         elif kind == "checkpoint-write":
@@ -161,6 +176,12 @@ class WatchState:
                                  f"{data.get('new_devices')}")
         elif kind == "mesh-straggler":
             self.mesh_stragglers += 1
+        elif kind == "slo-observation":
+            # one terminal SLO sample per session (ISSUE 20): folded
+            # into the live burn-rate rows below the session table
+            if "outcome" in data:
+                self.slo_obs.append(data)
+                del self.slo_obs[:-256]
         elif kind == "profile":
             self.profile_dir = data.get("profile_dir", self.profile_dir)
 
@@ -172,8 +193,11 @@ class WatchState:
 
     @property
     def mpc_step_latency_p50(self) -> float | None:
-        lat = sorted(self.mpc_latencies)
-        return lat[len(lat) // 2] if lat else None
+        """p50 over EVERY retained window (the histogram), not just
+        the recent display tail."""
+        if not self.mpc_hist.count:
+            return None
+        return self.mpc_hist.quantile(0.5)
 
 
 def _follow(path: str, state: WatchState, pos: int) -> int:
@@ -306,13 +330,19 @@ def merge_session_rows(states: dict[str, "WatchState"]) -> list[dict]:
     """Fold per-FILE states into per-SESSION rows.  A fleet-migrated
     session leaves one trace segment per replica it ran on (the same
     sid file name under each replica's subdirectory); the segments
-    join on (run id, session id) so the session counts ONCE, with the
-    newest segment supplying its current state and the replica chain
-    recording the journey."""
+    join on the CAUSAL TRACE ID (ISSUE 20) so the session counts ONCE,
+    with the newest segment supplying its current state and the
+    replica chain recording the journey; the (run id, session id)
+    heuristic remains only as the fallback for pre-trace segments."""
     groups: dict = {}
     for name in sorted(states):
         st = states[name]
-        key = (st.run, st.session) if st.run and st.session else name
+        if st.trace_id:
+            key = st.trace_id
+        elif st.run and st.session:
+            key = (st.run, st.session)
+        else:
+            key = name
         groups.setdefault(key, []).append((name, st))
     rows: list[dict] = []
     for key in groups:
@@ -405,6 +435,19 @@ def render_tenant_table(states: dict[str, "WatchState"]) -> str:
             moved = sum(1 for r in touched if len(r["chain"]) > 1)
             L.append(f"replica {rid}: {len(here)} session(s) "
                      f"resident, {done} terminal, {moved} migrated")
+    # live SLO burn rates (ISSUE 20): fold every settled session's
+    # slo-observation sample into the per-class error budgets
+    obs = [{"kind": "slo-observation", "data": d}
+           for st in states.values() for d in st.slo_obs]
+    if obs:
+        rep = _slo.evaluate_observations(obs)
+        for name, row in rep["slo"].items():
+            if not row["samples"]:
+                continue
+            verdict = "ok" if row["ok"] else "BUDGET EXHAUSTED"
+            L.append(f"slo {name}: burn {row['burn_rate']:.2f}  "
+                     f"budget left {row['budget_remaining']:.2f}  "
+                     f"({row['bad']}/{row['samples']} bad)  {verdict}")
     if not by_tenant:
         L.append("(no session traces yet)")
     return "\n".join(L)

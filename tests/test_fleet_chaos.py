@@ -92,7 +92,8 @@ def run_fleet_storm(seed: int, tmp_path) -> dict:
             for ln in fleet_log.read_text().splitlines()]
     return {"seed": seed, "plan": plan, "kill_rid": kill_rid,
             "records": records, "stats": stats, "wall": wall,
-            "alive": alive, "sessions": sessions, "rows": rows}
+            "alive": alive, "sessions": sessions, "rows": rows,
+            "trace_dir": str(tmp_path / f"traces{seed}")}
 
 
 def assert_fleet_storm_invariants(r: dict) -> None:
@@ -135,10 +136,45 @@ def assert_fleet_storm_invariants(r: dict) -> None:
     assert r["wall"] < 60.0
 
 
+def assert_trace_continuity(r: dict) -> None:
+    """ISSUE 20 (satellite c): a live-migrated session's trace is ONE
+    causal tree across the replica hand-off — zero orphan spans, the
+    migration span on the critical path, and the bucket partition
+    covering the client-observed latency within the 5% line."""
+    from mpisppy_tpu.telemetry import spans
+
+    seed = r["seed"]
+    # twelve clients, twelve distinct traces, minted at submit
+    trace_by_sid = {rec["session"]: rec["trace_id"]
+                    for rec in r["records"]}
+    assert len(set(trace_by_sid.values())) == 12
+    # sessions that LIVE-migrated (queued re-dispatches never started,
+    # so there is no segment to stitch)
+    migrated = {row["data"]["session"] for row in r["rows"]
+                if row["kind"] == "session-migrated"
+                and not row["data"].get("queued")}
+    assert migrated, f"seed {seed}: no live migration in the storm"
+    rows = spans.load_rows(r["trace_dir"])
+    for sid in sorted(migrated):
+        rep = spans.assemble(rows, trace_by_sid[sid])
+        assert rep["orphans"] == [], (seed, sid, rep["orphans"])
+        names = [sp["name"] for sp in rep["spans"]]
+        assert names[0] == "request", (seed, sid, names)
+        assert "migration" in names, (seed, sid, names)
+        assert rep["migrated_segments"] >= 1, (seed, sid)
+        cp = rep["critical_path"]
+        assert cp["buckets"].get("migration-gap", 0) > 0, \
+            (seed, sid, cp["buckets"])
+        assert cp["client_total_s"] is not None, (seed, sid)
+        assert abs(cp["coverage"] - 1.0) <= 0.05, (seed, sid, cp)
+
+
 def test_fleet_chaos_kill_replica_fast_seeded(tmp_path):
     """Tier-1 subset: two seeded storms (~15s wall together)."""
     for seed in (7, 31):
-        assert_fleet_storm_invariants(run_fleet_storm(seed, tmp_path))
+        r = run_fleet_storm(seed, tmp_path)
+        assert_fleet_storm_invariants(r)
+        assert_trace_continuity(r)
 
 
 @pytest.mark.slow

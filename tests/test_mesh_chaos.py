@@ -101,6 +101,8 @@ def _storm(tmp_path, seed, kill_iter=3, host=1):
     cap = _Cap()
     bus = EventBus()
     bus.subscribe(cap)
+    trace_file = str(tmp_path / f"mesh_trace{seed}.jsonl")
+    bus.subscribe(tel.JsonlSink(trace_file))
     ckpt = str(tmp_path / f"storm{seed}.npz")
     before = _metrics.REGISTRY.get("mesh_reshards_total")
     before_lost = _metrics.REGISTRY.get("mesh_reshards_lost_total")
@@ -131,6 +133,21 @@ def _storm(tmp_path, seed, kill_iter=3, host=1):
     assert gc <= REL_GAP + 1e-6
     slack = REL_GAP * max(abs(ib), abs(ic))
     assert ob <= ic + slack and oc <= ib + slack
+
+    # trace continuity (ISSUE 20 satellite c): the kill, the reshard
+    # and the resumed attempt are ONE causal tree — the pre-kill and
+    # post-reshard segments share the trace, the reshard span sits on
+    # the critical path, and no span is orphaned by the host loss
+    from mpisppy_tpu.telemetry import spans
+    trep = spans.assemble_path(trace_file)
+    assert trep["orphans"] == [], trep["orphans"]
+    names = [sp["name"] for sp in trep["spans"]]
+    assert names[0] == "mesh-run", names
+    assert names.count("mesh-segment") == 2, names
+    assert "reshard" in names, names
+    assert trep["migrated_segments"] == 1
+    assert trep["critical_path"]["buckets"].get(
+        "migration-gap", 0) > 0, trep["critical_path"]
     return info
 
 

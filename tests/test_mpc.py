@@ -179,8 +179,11 @@ def uc_streams(tmp_path_factory):
                      args=("--uc-n-gens", "2", "--uc-n-hours", "4"),
                      mpc_steps=steps, step_deadline_s=600.0)
 
+    trace_dir = str(tmp / "traces")
+    os.makedirs(trace_dir, exist_ok=True)
     base_lines = []
-    s0 = Session(stream_spec(), outbox=base_lines.append)
+    s0 = Session(stream_spec(), outbox=base_lines.append,
+                 trace_dir=trace_dir)
     watch = CompileWatch()
     deltas = {}
 
@@ -193,7 +196,8 @@ def uc_streams(tmp_path_factory):
     v0 = eng.run(s0)
 
     chaos_lines = []
-    s1 = Session(stream_spec(), outbox=chaos_lines.append)
+    s1 = Session(stream_spec(), outbox=chaos_lines.append,
+                 trace_dir=trace_dir)
     s1.checkpoint_path = str(tmp / "stream.npz")
     preempt_at = 2
     s1.on_step = (lambda sess: sess.preempt_event.set()
@@ -215,7 +219,8 @@ def uc_streams(tmp_path_factory):
             "settled": (settled_first, settled_again),
             "base_lines": base_lines, "verdict1": v1, "verdict2": v2,
             "chaos_lines": chaos_lines, "ckpt_existed": ckpt_existed,
-            "ckpt_path": s1.checkpoint_path, "preempt_at": preempt_at}
+            "ckpt_path": s1.checkpoint_path, "preempt_at": preempt_at,
+            "trace_path1": s1.trace_path}
 
 
 def _step_lines(lines):
@@ -439,3 +444,28 @@ def test_analyze_summarizes_mpc_stream_rows():
     plain = an.analyze(an.build_run_model(rows[:1] + rows[-1:]))
     assert plain["mpc"] is None
     assert "mpc stream" not in an.render_report(plain)
+
+
+def test_stream_trace_continuity_across_preempt_resume(uc_streams):
+    """ISSUE 20 (satellite c): the preempted stream and its resume are
+    ONE causal trace — every window's mpc-step span (including the
+    twice-started window at the preemption point) parents under the
+    same root, with zero orphan spans after the checkpoint restore."""
+    from mpisppy_tpu.telemetry import spans
+
+    rows = spans.load_rows(uc_streams["trace_path1"])
+    tids = spans.trace_ids(rows)
+    assert len(tids) == 1, tids
+    rep = spans.assemble(rows, tids[0])
+    assert rep["orphans"] == [], rep["orphans"]
+    names = [sp["name"] for sp in rep["spans"]]
+    assert names[0] == "request", names
+    step_spans = [sp for sp in rep["spans"] if sp["name"] == "mpc-step"]
+    # 4 windows + the re-solved preemption window start a 5th span
+    assert len(step_spans) >= uc_streams["steps"], names
+    root = rep["spans"][0]["span_id"]
+    assert all(sp["parent_span_id"] == root for sp in step_spans)
+    # both attempts' mpc-step rows carry the one trace id
+    steps_seen = {r["data"].get("step") for r in rows
+                  if r.get("kind") == "mpc-step"}
+    assert steps_seen == set(range(uc_streams["steps"]))

@@ -319,7 +319,7 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     # artifact also carries the ISSUE-11 async-overhead phase, the
     # ISSUE-12 serve isolation phase, the ISSUE-14 scengen phase, the
     # ISSUE-16 fleet migration phase, the ISSUE-17 mesh reshard phase,
-    # and the ISSUE-19 mpc stream phase)
+    # the ISSUE-19 mpc stream phase, and the ISSUE-20 slo rollup)
     won = json.load(open(r06))
     won["parsed"]["measured_mfu"]["S10000"]["sec_per_iter"] = 0.044
     won["parsed"]["sweep_iters_per_sec"][2]["iters_per_sec"] = 2.2
@@ -337,6 +337,8 @@ def test_gate_r06_fixture_and_milestones(tmp_path):
     won["parsed"]["mpc_stream"] = {
         "warm_over_cold_ratio": 0.5,
         "chaos": {"resumed_matched_frac": 1.0}}
+    won["parsed"]["slo"] = {
+        "latency": {"burn_rate": 0.0, "budget_remaining": 1.0}}
     won_path = tmp_path / "BENCH_won.json"
     won_path.write_text(json.dumps(won))
     rep2 = regress.gate_paths(r06, str(won_path), milestones=True)
@@ -948,3 +950,493 @@ def test_gate_r10_r11_mesh_chaos_keys_and_reshard_milestone(tmp_path):
     assert not rep3["ok"]
     assert any("reshard_reached_gap_frac" in r["metric"]
                for r in rep3["regressions"])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: causal tracing + the SLO plane — tracecontext/spans/slo
+# units, the committed golden fleet trace, the `trace`/`slo` CLI exit
+# codes, first-class histogram metrics, the trace-id joins in
+# analyze/watch, and the r12->r13 SLO gate fixture.
+# ---------------------------------------------------------------------------
+GOLDEN_FLEET = os.path.join(HERE, "fixtures",
+                            "golden_fleet_trace.jsonl")
+
+
+def test_tracecontext_mint_child_and_wire_roundtrip():
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    root = TraceContext.mint()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    int(root.trace_id, 16), int(root.span_id, 16)
+    assert root.parent_span_id == ""
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.span_id != root.span_id
+    assert kid.parent_span_id == root.span_id
+    # wire round trip drops the parent edge (W3C traceparent carries
+    # only the current position) but keeps trace + span
+    back = TraceContext.from_traceparent(kid.to_traceparent())
+    assert (back.trace_id, back.span_id) == (kid.trace_id, kid.span_id)
+    # garbage never raises — the server mints instead
+    for junk in (None, 42, "", "00-short-1234-01",
+                 "00-" + "g" * 32 + "-" + "1" * 16 + "-01",
+                 "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+                 "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+                 "a" * 32):
+        assert TraceContext.from_traceparent(junk) is None
+
+
+def test_bus_stamps_scoped_trace_and_per_emit_override():
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    got = []
+
+    class Grab(telemetry.Sink):
+        def handle(self, event):
+            got.append(json.loads(event.to_json()))
+
+    bus = telemetry.EventBus()
+    bus.subscribe(Grab())
+    bus.emit(telemetry.HUB_ITERATION, run="r", cyl="hub", hub_iter=0)
+    root = TraceContext.mint()
+    bus.set_trace(root)
+    bus.emit(telemetry.HUB_ITERATION, run="r", cyl="hub", hub_iter=1)
+    other = root.child()
+    bus.emit(telemetry.HUB_ITERATION, run="r", cyl="hub", hub_iter=2,
+             trace=other)
+    # pre-trace rows carry NO trace keys (same schema, old rows valid)
+    assert "trace_id" not in got[0] and "span_id" not in got[0]
+    assert got[1]["trace_id"] == root.trace_id
+    assert got[1]["span_id"] == root.span_id
+    assert "parent_span_id" not in got[1]
+    # per-emit override wins over the bus scope (shared-bus attribution)
+    assert got[2]["span_id"] == other.span_id
+    assert got[2]["parent_span_id"] == root.span_id
+
+
+def _traced_serve_rows():
+    """A hand-timed migrated-session trace: the bucket partition is
+    checked against exact wall-clock arithmetic."""
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    root = TraceContext.mint()
+    s1, mig, s2 = root.child(), root.child(), root.child()
+
+    def row(t, kind, ctx, seq, **data):
+        r = {"kind": kind, "seq": seq, "t_wall": t, "t_mono": t,
+             "run": "run-t", "cyl": "serve",
+             "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+             "data": data}
+        if ctx.parent_span_id:
+            r["parent_span_id"] = ctx.parent_span_id
+        return r
+
+    rows = [
+        row(100.0, "span-start", root, 1, name="request",
+            session="s01", tenant="acme", sla="latency"),
+        row(100.2, "session-state", root, 2, state="ADMITTED",
+            session="s01"),
+        row(100.3, "session-state", root, 3, state="RUNNING",
+            session="s01"),
+        row(100.35, "span-start", s1, 4, name="segment",
+            replica="r0"),
+        row(100.9, "hub-iteration", s1, 5, iter=0),
+        row(101.0, "hub-iteration", s1, 6, iter=1),
+        row(101.1, "session-migrated", s1, 7, session="s01",
+            from_replica="r0"),
+        row(101.6, "span-start", mig, 8, name="migration",
+            from_replica="r0"),
+        row(101.8, "span-start", s2, 9, name="segment",
+            replica="r1", restore=True),
+        row(102.0, "hub-iteration", s2, 10, iter=2),
+        row(102.3, "hub-iteration", s2, 11, iter=3),
+        row(102.4, "session-state", root, 12, state="DONE",
+            session="s01"),
+        row(102.5, "slo-observation", root, 13, outcome="done",
+            sla="latency", total_s=2.5),
+    ]
+    return root, rows
+
+
+def test_spans_assemble_tree_and_critical_path_partition(tmp_path):
+    from mpisppy_tpu.telemetry import spans
+
+    root, rows = _traced_serve_rows()
+    rep = spans.assemble(rows, root.trace_id)
+    assert rep["schema"] == spans.TRACE_SCHEMA
+    assert rep["orphans"] == []
+    assert [sp["name"] for sp in rep["spans"]] \
+        == ["request", "segment", "migration", "segment"]
+    assert [sp["depth"] for sp in rep["spans"]] == [0, 1, 1, 1]
+    assert rep["migrated_segments"] == 1
+    cp = rep["critical_path"]
+    # the buckets PARTITION the [first, last] wall timeline exactly
+    assert cp["total_s"] == pytest.approx(2.5)
+    assert sum(cp["buckets"].values()) == pytest.approx(2.5)
+    assert cp["buckets"]["queue-wait"] == pytest.approx(0.2)
+    assert cp["buckets"]["admission"] == pytest.approx(0.15)
+    assert cp["buckets"]["iter0"] == pytest.approx(0.75)
+    assert cp["buckets"]["hub-sync"] == pytest.approx(0.4)
+    assert cp["buckets"]["migration-gap"] == pytest.approx(0.8)
+    assert cp["buckets"]["solve"] == pytest.approx(0.2)
+    # ...and the sum equals the client-observed latency (coverage 1.0)
+    assert cp["client_total_s"] == pytest.approx(2.5)
+    assert cp["coverage"] == pytest.approx(1.0)
+    text = spans.render_trace(rep)
+    assert "migration" in text and "replica=r1" in text
+    assert "ORPHAN" not in text
+    # a dropped propagation hop (the root's rows vanish) is an orphan
+    torn = [r for r in rows if r["span_id"] != root.span_id]
+    rep2 = spans.assemble(torn, root.trace_id)
+    assert len(rep2["orphans"]) == 3
+    assert "ORPHAN SPANS: 3" in spans.render_trace(rep2)
+    # torn-tail safety: a half-written final line is skipped
+    path = tmp_path / "t.jsonl"
+    _jl(path, rows, torn_last=True)
+    rep3 = spans.assemble_path(str(path))
+    assert rep3["events"] == len(rows) - 1
+    assert rep3["orphans"] == []
+
+
+def test_spans_resolve_trace_id_prefixes_and_errors(tmp_path):
+    from mpisppy_tpu.telemetry import spans
+
+    _, rows_a = _traced_serve_rows()
+    _, rows_b = _traced_serve_rows()
+    for r in rows_b:
+        r["t_wall"] += 10.0
+    both = rows_a + rows_b
+    ta = rows_a[0]["trace_id"]
+    tb = rows_b[0]["trace_id"]
+    assert spans.trace_ids(both) == [ta, tb]
+    assert spans.resolve_trace_id(rows_a, None) == ta
+    assert spans.resolve_trace_id(both, "last") == tb
+    # a unique prefix resolves; ambiguity and no-rows are typed errors
+    n = next(i for i in range(1, 33) if ta[:i] != tb[:i])
+    assert spans.resolve_trace_id(both, ta[:n]) == ta
+    with pytest.raises(ValueError, match="multiple traces"):
+        spans.resolve_trace_id(both, None)
+    with pytest.raises(ValueError, match="matches 0"):
+        spans.resolve_trace_id(both, "zz")
+    with pytest.raises(ValueError, match="no trace-stamped"):
+        spans.resolve_trace_id([{"kind": "run-start"}], None)
+
+
+def test_golden_fleet_trace_assembles_zero_orphan(tmp_path):
+    """The committed fixture — one live-migrated session recorded from
+    the fleet chaos storm — assembles into ONE zero-orphan causal tree
+    whose critical path covers the client-observed latency within the
+    5% acceptance line, with the migration span on the path."""
+    from mpisppy_tpu.telemetry import spans
+
+    rep = spans.assemble_path(GOLDEN_FLEET)
+    assert rep["schema"] == spans.TRACE_SCHEMA
+    assert rep["orphans"] == []
+    names = [sp["name"] for sp in rep["spans"]]
+    assert names[0] == "request"
+    assert "migration" in names
+    assert names.count("segment") == 2
+    assert rep["migrated_segments"] == 1
+    cp = rep["critical_path"]
+    assert cp["buckets"]["migration-gap"] > 0
+    assert sum(cp["buckets"].values()) == pytest.approx(cp["total_s"])
+    assert cp["client_total_s"] is not None
+    assert abs(cp["coverage"] - 1.0) <= 0.05
+    # CLI: a clean tree exits 0, an orphaned one exits 2
+    out = subprocess.run(CLI + ["trace", "--trace-jsonl", GOLDEN_FLEET,
+                                "--json"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout)["trace_id"] == rep["trace_id"]
+    rows = spans.load_rows(GOLDEN_FLEET)
+    root = next(sp["span_id"] for sp in rep["spans"]
+                if sp["name"] == "request")
+    torn = tmp_path / "orphaned.jsonl"
+    _jl(torn, [r for r in rows if r.get("span_id") != root])
+    out2 = subprocess.run(CLI + ["trace", "--trace-jsonl", str(torn)],
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=120, env=ENV)
+    assert out2.returncode == 2
+    assert "ORPHAN" in out2.stdout
+
+
+def test_session_trace_adoption_and_slo_observation(tmp_path):
+    """Session adopts the client's traceparent, stamps every row of its
+    per-session trace with it, and settles exactly one slo-observation
+    sample carrying the client-joinable total."""
+    from mpisppy_tpu.serve.protocol import SubmitRequest
+    from mpisppy_tpu.serve.session import Session
+    from mpisppy_tpu.telemetry import spans
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    minted = TraceContext.mint()
+    spec = SubmitRequest(tenant="acme", sla="latency", model="farmer",
+                         num_scens=3,
+                         traceparent=minted.to_traceparent())
+    s = Session(spec, outbox=lambda m: None,
+                trace_dir=str(tmp_path))
+    assert s.trace.trace_id == minted.trace_id
+    s.transition("ADMITTED")
+    s.transition("RUNNING")
+    s.begin_segment()
+    assert s.segment.parent_span_id == s.trace.span_id
+    s.end_segment()
+    assert s.settle("done", rel_gap=0.004)
+    rows = spans.load_rows(s.trace_path)
+    assert rows and all(r.get("trace_id") == minted.trace_id
+                        for r in rows)
+    obs = [r for r in rows if r["kind"] == "slo-observation"]
+    assert len(obs) == 1
+    d = obs[0]["data"]
+    assert d["outcome"] == "done" and d["sla"] == "latency"
+    assert d["total_s"] > 0
+    # the sample lands on the request ROOT span (not the segment)
+    assert obs[0]["span_id"] == minted.span_id
+    rep = spans.assemble(rows, minted.trace_id)
+    assert rep["orphans"] == []
+    assert [sp["name"] for sp in rep["spans"]][:2] \
+        == ["request", "segment"]
+    # a garbage traceparent never errors: the session mints instead
+    s2 = Session(SubmitRequest(tenant="acme", sla="latency",
+                               model="farmer", num_scens=3,
+                               traceparent="garbage"),
+                 outbox=lambda m: None)
+    assert len(s2.trace.trace_id) == 32
+
+
+def test_slo_evaluate_observations_classes_and_budgets():
+    from mpisppy_tpu.telemetry import slo
+
+    def ob(**d):
+        return {"kind": "slo-observation", "data": d}
+
+    rows = [
+        ob(outcome="done", sla="latency", total_s=10.0),
+        ob(outcome="done", sla="latency", total_s=20.0),   # over 15s
+        ob(outcome="failed", sla="latency", total_s=3.0),
+        ob(outcome="done", sla="throughput", total_s=50.0),
+        # streams evaluate per WINDOW, not per session
+        ob(outcome="done", sla="latency", total_s=4.0,
+           steps_expected=4, steps=4),
+        ob(outcome="failed", sla="latency", total_s=2.0,
+           steps_expected=4, steps=2),
+    ]
+    rep = slo.evaluate_observations(rows)
+    assert rep["schema"] == slo.SLO_SCHEMA
+    lat = rep["slo"]["latency"]
+    assert (lat["samples"], lat["bad"]) == (3, 2)
+    assert lat["burn_rate"] == pytest.approx((2 / 3) / 0.05, rel=1e-3)
+    assert not lat["ok"] and lat["budget_remaining"] == 0.0
+    thr = rep["slo"]["throughput"]
+    assert (thr["samples"], thr["bad"]) == (1, 0)
+    assert thr["ok"] and thr["burn_rate"] == 0.0
+    mpc = rep["slo"]["mpc"]
+    assert (mpc["samples"], mpc["bad"]) == (8, 2)
+    assert mpc["burn_rate"] == pytest.approx(0.25 / 0.10, rel=1e-3)
+    assert not mpc["ok"]
+    # absence of traffic is not a violation: zero samples burn nothing
+    empty = slo.evaluate_observations([])
+    assert all(r["samples"] == 0 and r["ok"] and r["burn_rate"] == 0.0
+               for r in empty["slo"].values())
+    text = slo.render_slo(rep)
+    assert "VIOLATED" in text and "latency" in text
+
+
+def test_slo_bench_evaluation_and_cli_exit_codes(tmp_path):
+    """`telemetry slo --bench` on the committed r13 artifact is green;
+    a synthetic budget-exhausting artifact exits 2."""
+    from mpisppy_tpu.telemetry import regress, slo
+
+    parsed = regress.load_artifact(os.path.join(REPO, "BENCH_r13.json"))
+    rep = slo.evaluate_bench(parsed)
+    assert set(rep["slo"]) == {"latency", "throughput", "mpc"}
+    for row in rep["slo"].values():
+        assert row["ok"] and row["burn_rate"] == 0.0
+        assert row["samples"] > 0
+    # the committed artifact's own slo sections match a re-evaluation
+    assert parsed["slo"]["latency"]["burn_rate"] \
+        == rep["slo"]["latency"]["burn_rate"]
+    out = subprocess.run(CLI + ["slo", "--bench", "BENCH_r13.json"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "burn" in out.stdout
+    # every window degraded: burn 10x the budget -> exit 2
+    burned = {"device": "cpu", "parsed": {"mpc_stream": {"uc": {
+        "steps": 4, "degraded_steps": 4, "step_latency_p99_s": 1.0}}}}
+    bp = tmp_path / "burned.json"
+    bp.write_text(json.dumps(burned))
+    out2 = subprocess.run(CLI + ["slo", "--bench", str(bp)],
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=120, env=ENV)
+    assert out2.returncode == 2
+    assert "VIOLATED" in out2.stdout
+
+
+def test_gate_r12_r13_slo_keys_and_burn_milestone(tmp_path):
+    """ISSUE 20 gate fixture: the committed r12->r13 pair gates green
+    with the per-class slo.*.burn_rate keys bound by the <= 1.0
+    milestone; a synthetic burn-rate rise (or budget_remaining drop)
+    on a committed artifact exits 2 — burn starts at 0, so ANY
+    increase trips the relative gate."""
+    r12 = os.path.join(REPO, "BENCH_r12.json")
+    r13 = os.path.join(REPO, "BENCH_r13.json")
+    rep = regress.gate_paths(r12, r13)
+    assert rep["ok"], rep["regressions"]
+    ms = {r["metric"]: r for r in rep["milestones"]
+          if ".burn_rate" in r["metric"]}
+    assert "slo.latency.burn_rate" in ms
+    assert "slo.mpc.burn_rate" in ms
+    assert all(r["status"] == "met" and r["milestone"] == 1.0
+               for r in ms.values())
+
+    slip = json.load(open(r13))
+    slip["parsed"]["slo"]["latency"]["burn_rate"] = 0.5
+    slip["parsed"]["slo"]["latency"]["budget_remaining"] = 0.5
+    slip_path = tmp_path / "BENCH_burn_slip.json"
+    slip_path.write_text(json.dumps(slip))
+    rep2 = regress.gate_paths(r13, str(slip_path))
+    assert not rep2["ok"]
+    failed = {r["metric"] for r in rep2["regressions"]}
+    assert "slo.latency.burn_rate" in failed
+    assert "slo.latency.budget_remaining" in failed
+    from mpisppy_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main(["gate", r12, r13]) == 0
+    assert tel_main(["gate", r13, str(slip_path)]) == 2
+
+
+def test_histogram_quantiles_and_prom_exposition():
+    from mpisppy_tpu.telemetry import metrics as m
+
+    h = m.Histogram()
+    assert h.quantile(0.5) is None
+    for v in (0.02, 0.03, 0.04, 0.2, 0.3, 0.4, 8.0, 9.0):
+        h.observe(v)
+    assert h.count == 8 and h.sum == pytest.approx(17.99)
+    p50, p99 = h.quantile(0.5), h.quantile(0.99)
+    assert 0.025 < p50 <= 0.5
+    assert p99 >= 5.0                      # lands in the 5..10 bucket
+    assert h.quantile(0.0) <= p50 <= p99
+    # registry-held histograms render the Prometheus histogram model
+    reg = m.MetricsRegistry()
+    reg.observe("mpc_step_latency_hist_s", 0.3, stream="uc")
+    reg.observe("mpc_step_latency_hist_s", 2.0, stream="uc")
+    text = reg.render_prom()
+    assert "# TYPE mpc_step_latency_hist_s histogram" in text
+    assert 'mpc_step_latency_hist_s_bucket{stream="uc",le="+Inf"} 2' \
+        in text
+    assert 'mpc_step_latency_hist_s_count{stream="uc"} 2' in text
+    assert "mpc_step_latency_hist_s_sum" in text
+    snap = reg.to_snapshot()
+    assert snap["histograms"]['mpc_step_latency_hist_s{stream="uc"}'][
+        "count"] == 2
+
+
+def test_analyze_trace_dir_joins_segments_on_trace_id(tmp_path):
+    """Satellite (a): a migrated session's segments carry DIFFERENT run
+    ids on different replicas — the (run, sid) heuristic cannot join
+    them, the causal trace id does; the report disclosed the join."""
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    root = TraceContext.mint()
+    td = tmp_path / "traces"
+    (td / "r0").mkdir(parents=True)
+    (td / "r1").mkdir()
+
+    def row(t, kind, run, **data):
+        return {"kind": kind, "run": run, "t_wall": t, "t_mono": t,
+                "trace_id": root.trace_id, "span_id": root.span_id,
+                "data": data}
+
+    _jl(td / "r0" / "session-s01.jsonl", [
+        row(100.0, "run-start", "run-a", hub_class="PHHub",
+            num_spokes=2),
+        row(100.5, "session-state", "run-a", session="s01",
+            state="RUNNING", replica="r0"),
+        row(101.0, "session-migrated", "run-a", session="s01",
+            from_replica="r0"),
+    ])
+    _jl(td / "r1" / "session-s01.jsonl", [
+        row(102.0, "run-start", "run-b", hub_class="PHHub",
+            num_spokes=2),
+        row(102.5, "session-state", "run-b", session="s01",
+            state="RUNNING", replica="r1"),
+        row(103.0, "run-end", "run-b", reason="converged",
+            rel_gap=0.004),
+    ])
+    rep = an.analyze_path(str(td))
+    assert rep["run"]["migrated_segments"] == 1
+    assert sorted(rep["run"]["segment_files"]) == [
+        os.path.join("r0", "session-s01.jsonl"),
+        os.path.join("r1", "session-s01.jsonl")]
+    assert rep["run"]["exit"]["reason"] == "converged"
+    assert "migrated segments 1" in an.render_report(rep)
+
+
+def test_watch_joins_segments_on_trace_id_and_burn_footer(tmp_path):
+    """Satellite (b): watch joins migrated segments on the causal trace
+    id even across run-id changes, folds EVERY step latency into the
+    histogram-backed p50 (bounded retention), and renders the live SLO
+    burn-rate footer from slo-observation rows."""
+    from mpisppy_tpu.telemetry import watch as w
+    from mpisppy_tpu.telemetry.tracecontext import TraceContext
+
+    root = TraceContext.mint()
+    td = tmp_path / "traces"
+    (td / "r0").mkdir(parents=True)
+    (td / "r1").mkdir()
+
+    def row(t, kind, run, **data):
+        return {"kind": kind, "run": run, "t_wall": t, "t_mono": t,
+                "trace_id": root.trace_id, "span_id": root.span_id,
+                "data": data}
+
+    steps_r0 = [row(100.5 + k / 10, "mpc-step", "run-a", step=k,
+                    warm=k > 0, latency_s=0.1)
+                for k in range(100)]
+    _jl(td / "r0" / "session-s01.jsonl", [
+        row(100.0, "session-state", "run-a", session="s01",
+            tenant="acme", sla="latency", state="RUNNING",
+            replica="r0"),
+        *steps_r0,
+        row(111.0, "session-migrated", "run-a", session="s01",
+            from_replica="r0", migrations=1),
+    ])
+    steps_r1 = [row(111.5 + k / 10, "mpc-step", "run-b", step=100 + k,
+                    warm=True, latency_s=0.1) for k in range(4)]
+    _jl(td / "r1" / "session-s01.jsonl", [
+        row(111.4, "session-state", "run-b", session="s01",
+            tenant="acme", sla="latency", state="RUNNING",
+            replica="r1"),
+        *steps_r1,
+        row(112.0, "session-state", "run-b", session="s01",
+            state="DONE", replica="r1"),
+        row(112.1, "slo-observation", "run-b", outcome="done",
+            sla="latency", session="s01", total_s=12.1),
+    ])
+    states: dict = {}
+    for name in ("r0/session-s01.jsonl", "r1/session-s01.jsonl"):
+        st = states.setdefault(name, w.WatchState())
+        w._follow(str(td / name), st, 0)
+    rows = w.merge_session_rows(states)
+    assert len(rows) == 1                   # trace id beat the run ids
+    assert rows[0]["chain"] == ["r0", "r1"]
+    assert rows[0]["state"] == "DONE"
+    assert rows[0]["mpc_steps"] == 104
+    # histogram p50 covers ALL 100 windows while the raw display tail
+    # retains only the last 64 — bounded memory, unbounded coverage
+    st0 = states["r0/session-s01.jsonl"]
+    assert st0.mpc_hist.count == 100
+    assert len(st0.mpc_latencies) == 64
+    assert rows[0]["step_p50"] == pytest.approx(0.1, rel=0.5)
+    table = w.render_tenant_table(states)
+    assert "r0>r1" in table
+    assert "slo latency: burn 0.00" in table
+    # slo-observation retention is capped too
+    st1 = states["r1/session-s01.jsonl"]
+    for _ in range(300):
+        st1.feed({"kind": "slo-observation", "run": "run-b",
+                     "data": {"outcome": "done", "sla": "latency",
+                              "total_s": 1.0}})
+    assert len(st1.slo_obs) == 256

@@ -1,6 +1,6 @@
 ###############################################################################
 # schema-drift: the telemetry taxonomy is kept consistent by machine,
-# not by reviewer memory.  Four sub-checks, one rule:
+# not by reviewer memory.  Five sub-checks, one rule:
 #
 #   1. EMIT KINDS — every event kind emitted anywhere in the library
 #      (`bus.emit("...")`, `self._emit(tel.X, ...)`,
@@ -24,6 +24,15 @@
 #      reports derived from the committed tests/fixtures/
 #      golden_*.jsonl traces.  A gate nothing can produce is dead
 #      armor — it looks like protection and gates nothing.
+#   5. REPORT SCHEMAS — every versioned `*_SCHEMA` identifier the
+#      tooling modules declare (analyze / spans / slo) must be
+#      documented in docs/telemetry.md, and the TRACE schema
+#      (`mpisppy-tpu-trace/1`) must additionally be WITNESSED: at
+#      least one committed golden fixture with trace-context rows
+#      must assemble into a zero-orphan span tree carrying that
+#      schema.  A schema no committed fixture produces is dead
+#      vocabulary; an orphaned golden trace is a dropped propagation
+#      hop checked into the repo.
 #
 # Events/metrics declarations are read by AST (no import of the
 # package under scan); the gate-key check loads telemetry/regress.py
@@ -63,6 +72,25 @@ def declared_kinds(ctx: Context):
     return kinds, rel, consts
 
 
+def declared_schemas(ctx: Context) -> dict[str, tuple[str, int]]:
+    """Versioned report-schema identifiers (`*_SCHEMA = "..."` module
+    constants) declared by the telemetry tooling modules."""
+    out: dict[str, tuple[str, int]] = {}
+    for rel in (f"{ctx.lib_dir}/telemetry/analyze.py",
+                f"{ctx.lib_dir}/telemetry/spans.py",
+                f"{ctx.lib_dir}/telemetry/slo.py"):
+        if not os.path.exists(ctx.abspath(rel)):
+            continue
+        for node in ctx.tree(rel).body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_SCHEMA") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[node.value.value] = (rel, node.lineno)
+    return out
+
+
 def declared_metrics(ctx: Context):
     rel = f"{ctx.lib_dir}/telemetry/metrics.py"
     if not os.path.exists(ctx.abspath(rel)):
@@ -82,7 +110,7 @@ def declared_metrics(ctx: Context):
 
 # -- call-site extraction ---------------------------------------------------
 _EMIT_WRAPPER_NAMES = {"_emit", "_emit_event"}
-_METRIC_METHODS = {"inc", "set_gauge", "set_counter"}
+_METRIC_METHODS = {"inc", "set_gauge", "set_counter", "observe"}
 
 
 def _forwarding_wrappers(tree: ast.AST) -> set[str]:
@@ -333,6 +361,74 @@ def run(ctx: Context) -> list[Finding]:
                         f"golden-trace analyzer report) — a gate "
                         f"nothing produces gates nothing",
                         key=f"gate-unresolved::{pat}"))
+
+    # 5. report schemas: documented, and the trace schema witnessed by
+    #    a committed zero-orphan golden fixture
+    schemas = declared_schemas(ctx)
+    doc_path = ctx.abspath("docs/telemetry.md")
+    doc_src = ""
+    if os.path.exists(doc_path):
+        with open(doc_path) as f:
+            doc_src = f.read()
+    if doc_src:
+        for schema, (rel, line) in sorted(schemas.items()):
+            if schema not in doc_src:
+                out.append(Finding(
+                    RULE_NAME, rel, line,
+                    f"report schema {schema!r} is not documented in "
+                    f"docs/telemetry.md",
+                    key=f"schema-undocumented::{schema}"))
+    spans_rel = f"{ctx.lib_dir}/telemetry/spans.py"
+    trace_schemas = sorted(s for s in schemas if "-trace/" in s)
+    if trace_schemas and os.path.exists(ctx.abspath(spans_rel)):
+        try:
+            spans_mod = _load_by_path(ctx, spans_rel, "spans")
+        except Exception as e:
+            out.append(Finding(RULE_NAME, spans_rel, 1,
+                               f"could not load spans.py: {e}",
+                               key="spans-unloadable"))
+            spans_mod = None
+        witnessed: set[str] = set()
+        if spans_mod is not None:
+            for fx in sorted(glob.glob(os.path.join(
+                    ctx.root, "tests", "fixtures", "golden_*.jsonl"))):
+                fx_rel = os.path.relpath(fx, ctx.root)
+                try:
+                    if not spans_mod.trace_ids(spans_mod.load_rows(fx)):
+                        continue    # pre-trace-context fixture: no rows
+                    rep = spans_mod.assemble_path(fx, trace="last")
+                except Exception as e:
+                    out.append(Finding(
+                        RULE_NAME, fx_rel, 1,
+                        f"golden trace fixture does not assemble into "
+                        f"a span tree: {e}",
+                        key=f"fixture-unassemblable::{fx_rel}"))
+                    continue
+                if rep.get("schema") not in schemas:
+                    out.append(Finding(
+                        RULE_NAME, fx_rel, 1,
+                        f"assembled fixture carries undeclared schema "
+                        f"{rep.get('schema')!r}",
+                        key=f"fixture-schema::{fx_rel}"))
+                    continue
+                if rep.get("orphans"):
+                    out.append(Finding(
+                        RULE_NAME, fx_rel, 1,
+                        f"committed golden trace has "
+                        f"{len(rep['orphans'])} orphan span(s) — a "
+                        f"dropped trace-propagation hop is checked in",
+                        key=f"fixture-orphans::{fx_rel}"))
+                    continue
+                witnessed.add(rep["schema"])
+            for schema in trace_schemas:
+                if schema not in witnessed:
+                    out.append(Finding(
+                        RULE_NAME, spans_rel, 1,
+                        f"trace schema {schema!r} is witnessed by no "
+                        f"committed golden fixture (no tests/fixtures/"
+                        f"golden_*.jsonl with trace-context rows "
+                        f"assembles to it)",
+                        key=f"schema-unwitnessed::{schema}"))
     return out
 
 
